@@ -1,0 +1,29 @@
+"""poseidon_tpu — a TPU-native rebuild of the Poseidon/Firmament flow-network
+cluster scheduler.
+
+The reference system (hanxiaoshuai/poseidon) is the Kubernetes glue half of a
+two-process scheduler: Poseidon (Go) watches pods/nodes and drives a
+``Schedule()`` RPC loop against Firmament (external C++), which models the
+cluster as a min-cost max-flow network and solves it each round
+(reference: README.md:4-9, cmd/poseidon/poseidon.go:32-72).
+
+This package is the whole system rebuilt TPU-first:
+
+- ``poseidon_tpu.protos``     — the frozen wire contract (same proto packages /
+  field numbers as reference pkg/firmament/*.proto + pkg/stats/poseidonstats.proto).
+- ``poseidon_tpu.fgraph``     — the flow network as dense, statically-shaped
+  arrays (equivalence-class collapsed transportation instance).
+- ``poseidon_tpu.ops``        — jit-compiled solvers: epsilon-scaling auction
+  for the bipartite transportation core, dense general min-cost max-flow.
+- ``poseidon_tpu.costs``      — vectorized cost models (CPU/Mem multi-dim,
+  selector gating, net-aware, Whare-Map, CoCo).
+- ``poseidon_tpu.parallel``   — machine-axis sharding of the solver over a
+  ``jax.sharding.Mesh`` (ICI collectives via shard_map).
+- ``poseidon_tpu.service``    — the ``firmament-tpu`` scheduler service: the 13
+  RPCs of firmament.FirmamentScheduler with exact reply-enum semantics.
+- ``poseidon_tpu.k8s``        — the Poseidon glue: pod/node watchers, keyed
+  queue, binder, schedule loop, plus an in-process fake K8s cluster.
+- ``poseidon_tpu.statsvc``    — the stats.PoseidonStats ingestion service.
+"""
+
+__version__ = "0.1.0"

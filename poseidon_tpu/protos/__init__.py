@@ -1,0 +1,29 @@
+"""Wire-contract protos for the firmament-tpu scheduler.
+
+Exposes the generated message modules as ``firmament_pb2`` / ``stats_pb2``.
+If the generated modules are missing (fresh checkout without codegen), they
+are regenerated on the fly with protoc.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+_HERE = Path(__file__).resolve().parent
+
+
+def _ensure_generated() -> None:
+    from poseidon_tpu.protos import gen
+
+    if any(
+        not (_HERE / (p.rsplit(".", 1)[0] + "_pb2.py")).exists() for p in gen.PROTOS
+    ):
+        gen.generate()
+
+
+_ensure_generated()
+
+from poseidon_tpu.protos import firmament_pb2  # noqa: E402
+from poseidon_tpu.protos import poseidonstats_pb2 as stats_pb2  # noqa: E402
+
+__all__ = ["firmament_pb2", "stats_pb2"]

@@ -1,0 +1,41 @@
+"""Regenerate the protobuf Python modules from the .proto sources.
+
+Run as: ``python -m poseidon_tpu.protos.gen``
+
+The generated ``*_pb2.py`` files are checked in so importing the package does
+not require protoc; this script exists to regenerate them after contract
+edits (the contract is frozen against the reference, so that should be rare).
+
+gRPC service stubs are NOT generated (the image has no grpc protoc plugin);
+service wiring is done by hand from the method tables in
+``poseidon_tpu.protos.services``.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+import sys
+
+HERE = pathlib.Path(__file__).resolve().parent
+PROTOS = ["firmament.proto", "poseidonstats.proto"]
+
+
+def protoc_command() -> list:
+    return ["protoc", f"--proto_path={HERE}", f"--python_out={HERE}"] + [
+        str(HERE / p) for p in PROTOS
+    ]
+
+
+def generate() -> None:
+    subprocess.check_call(protoc_command())
+
+
+def main() -> int:
+    cmd = protoc_command()
+    print("+", " ".join(cmd))
+    return subprocess.call(cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,104 @@
+"""Wire-contract tests: the consolidated protos must be wire-compatible with
+the reference's proto layout (same packages, message names, field numbers)."""
+
+from poseidon_tpu.protos import firmament_pb2 as fpb
+from poseidon_tpu.protos import stats_pb2 as spb
+
+
+def test_task_descriptor_roundtrip():
+    td = fpb.TaskDescriptor(
+        uid=42,
+        name="default/pod-0",
+        state=fpb.TaskDescriptor.RUNNABLE,
+        job_id="job-uuid",
+        resource_request=fpb.ResourceVector(cpu_cores=250.0, ram_cap=1024),
+        priority=5,
+        task_type=fpb.TaskDescriptor.DEVIL,
+        labels=[fpb.Label(key="a", value="b")],
+        label_selectors=[
+            fpb.LabelSelector(
+                type=fpb.LabelSelector.IN_SET, key="zone", values=["us-east"]
+            )
+        ],
+    )
+    blob = td.SerializeToString()
+    back = fpb.TaskDescriptor.FromString(blob)
+    assert back.uid == 42
+    assert back.resource_request.cpu_cores == 250.0
+    assert back.label_selectors[0].values == ["us-east"]
+
+
+def test_field_numbers_match_reference():
+    # Spot-check wire numbering against the reference protos
+    # (task_desc.proto, resource_desc.proto, scheduling_delta.proto).
+    td_fields = {
+        f.name: f.number for f in fpb.TaskDescriptor.DESCRIPTOR.fields
+    }
+    assert td_fields["uid"] == 1
+    assert td_fields["resource_request"] == 26
+    assert td_fields["task_type"] == 28
+    assert td_fields["trace_task_id"] == 31
+    assert td_fields["labels"] == 32
+    assert td_fields["label_selectors"] == 33
+
+    rd_fields = {
+        f.name: f.number for f in fpb.ResourceDescriptor.DESCRIPTOR.fields
+    }
+    assert rd_fields["task_capacity"] == 5
+    assert rd_fields["resource_capacity"] == 18
+    assert rd_fields["labels"] == 32
+
+    sd_fields = {
+        f.name: f.number for f in fpb.SchedulingDelta.DESCRIPTOR.fields
+    }
+    assert sd_fields == {"task_id": 1, "resource_id": 2, "type": 3}
+    assert fpb.SchedulingDelta.PLACE == 1
+    assert fpb.SchedulingDelta.PREEMPT == 2
+    assert fpb.SchedulingDelta.MIGRATE == 3
+
+
+def test_reply_enums_match_reference():
+    # firmament_scheduler.proto:110-129
+    assert fpb.TASK_COMPLETED_OK == 0
+    assert fpb.TASK_SUBMITTED_OK == 1
+    assert fpb.TASK_REMOVED_OK == 2
+    assert fpb.TASK_FAILED_OK == 3
+    assert fpb.TASK_UPDATED_OK == 4
+    assert fpb.TASK_NOT_FOUND == 5
+    assert fpb.TASK_JOB_NOT_FOUND == 6
+    assert fpb.TASK_ALREADY_SUBMITTED == 7
+    assert fpb.TASK_STATE_NOT_CREATED == 8
+    assert fpb.NODE_ADDED_OK == 0
+    assert fpb.NODE_NOT_FOUND == 4
+    assert fpb.NODE_ALREADY_EXISTS == 5
+    assert fpb.SERVING == 1
+
+
+def test_stats_protos():
+    ps = spb.PodStats(name="p", namespace="ns", hostname="h", cpu_usage=5)
+    assert spb.PodStats.FromString(ps.SerializeToString()).cpu_usage == 5
+    fields = {f.name: f.number for f in spb.PodStats.DESCRIPTOR.fields}
+    assert fields["net_tx_rate"] == 24
+    assert spb.POD_NOT_FOUND == 1
+    assert spb.NODE_NOT_FOUND == 1
+
+
+def test_service_method_tables():
+    from poseidon_tpu.protos import services
+
+    assert set(services.FIRMAMENT_METHODS) == {
+        "Schedule",
+        "TaskCompleted",
+        "TaskFailed",
+        "TaskRemoved",
+        "TaskSubmitted",
+        "TaskUpdated",
+        "NodeAdded",
+        "NodeFailed",
+        "NodeRemoved",
+        "NodeUpdated",
+        "AddTaskStats",
+        "AddNodeStats",
+        "Check",
+    }
+    assert services.STATS_METHODS["ReceivePodStats"].arity == "stream_stream"

@@ -1,0 +1,74 @@
+from poseidon_tpu.utils import ids
+from poseidon_tpu.utils.config import (
+    FirmamentTPUConfig,
+    PoseidonConfig,
+    load_config,
+)
+
+
+def test_fnv64a_known_vectors():
+    # Standard FNV-1a 64 test vectors.
+    assert ids.fnv64a("") == 0xCBF29CE484222325
+    assert ids.fnv64a("a") == 0xAF63DC4C8601EC8C
+    assert ids.fnv64a("foobar") == 0x85944171F73967E8
+
+
+def test_uuid_deterministic_and_valid():
+    u1 = ids.generate_uuid("default/my-job")
+    u2 = ids.generate_uuid("default/my-job")
+    u3 = ids.generate_uuid("default/other-job")
+    assert u1 == u2 != u3
+    parts = u1.split("-")
+    assert [len(p) for p in parts] == [8, 4, 4, 4, 12]
+    assert parts[2][0] == "4"  # version 4
+    assert parts[3][0] in "89ab"  # RFC4122 variant
+
+
+def test_task_uid_hash_combine():
+    job = ids.generate_uuid("ns/job")
+    uids = {ids.task_uid(job, i) for i in range(100)}
+    assert len(uids) == 100  # no collisions across indices
+    assert ids.task_uid(job, 0) == ids.task_uid(job, 0)
+
+
+def test_config_defaults_match_reference():
+    cfg = load_config(PoseidonConfig, argv=[])
+    assert cfg.scheduler_name == "poseidon"
+    assert cfg.firmament_address == "firmament-service.kube-system:9090"
+    assert cfg.stats_server_address == "0.0.0.0:9091"
+    assert cfg.scheduling_interval == 10.0
+
+
+def test_config_file_and_flag_precedence(tmp_path):
+    f = tmp_path / "cfg.yaml"
+    f.write_text("schedulerName: custom\nschedulingInterval: 3\n")
+    cfg = load_config(PoseidonConfig, argv=[f"--config-file={f}"])
+    assert cfg.scheduler_name == "custom"
+    assert cfg.scheduling_interval == 3
+    # Explicit flags beat the file (config.go:113-128 semantics).
+    cfg = load_config(
+        PoseidonConfig,
+        argv=[f"--config-file={f}", "--scheduler-name=flagwins"],
+    )
+    assert cfg.scheduler_name == "flagwins"
+
+
+def test_service_config():
+    cfg = load_config(FirmamentTPUConfig, argv=["--cost-model=trivial"])
+    assert cfg.cost_model == "trivial"
+    assert cfg.flow_solver == "auction"
+
+
+def test_config_strictness_and_bool_flags():
+    import pytest
+
+    # Unknown flags are errors (pflag semantics), not silently dropped.
+    with pytest.raises(SystemExit):
+        load_config(PoseidonConfig, argv=["--cost-modle=coco"])
+    # Bare bool flag means true; explicit false works; garbage is an error.
+    assert load_config(FirmamentTPUConfig, argv=["--gang-scheduling"]).gang_scheduling
+    assert not load_config(
+        FirmamentTPUConfig, argv=["--gang-scheduling=false"]
+    ).gang_scheduling
+    with pytest.raises(SystemExit):
+        load_config(FirmamentTPUConfig, argv=["--gang-scheduling=ture"])
