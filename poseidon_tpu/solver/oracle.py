@@ -1,0 +1,81 @@
+"""Host-side exact min-cost-flow oracle.
+
+Stands in for upstream Firmament's cs2 solver as the placement-cost parity
+reference (SURVEY.md section 7 step 3): the TPU auction solver is verified
+against this on randomized instances and on the benchmark configs.
+
+Built on networkx's network simplex (exact for integer data).  Slow but
+trustworthy; only used in tests and offline parity runs.
+"""
+
+from __future__ import annotations
+
+import networkx as nx
+import numpy as np
+
+from poseidon_tpu.ops.transport import INF_COST
+
+
+def transport_objective(
+    costs: np.ndarray,
+    supply: np.ndarray,
+    capacity: np.ndarray,
+    unsched_cost: np.ndarray,
+) -> int:
+    """Exact optimal objective of the EC->machine transportation instance.
+
+    Graph: source -> EC (cap s_e) -> machine (cost C[e,m]) -> sink
+    (cap c_m), plus EC -> sink fallback arcs at the unscheduled cost.
+    Always feasible because of the fallback.
+    """
+    costs = np.asarray(costs)
+    supply = np.asarray(supply)
+    capacity = np.asarray(capacity)
+    unsched_cost = np.asarray(unsched_cost)
+    E, M = costs.shape
+    total = int(supply.sum())
+
+    g = nx.DiGraph()
+    g.add_node("src", demand=-total)
+    g.add_node("sink", demand=total)
+    for e in range(E):
+        s = int(supply[e])
+        if s == 0:
+            continue
+        g.add_edge("src", ("ec", e), capacity=s, weight=0)
+        g.add_edge(("ec", e), "sink", capacity=s, weight=int(unsched_cost[e]))
+        for m in range(M):
+            c = int(costs[e, m])
+            if c >= INF_COST or capacity[m] <= 0:
+                continue
+            g.add_edge(("ec", e), ("mach", m), capacity=s, weight=c)
+    for m in range(M):
+        if capacity[m] > 0:
+            g.add_edge(("mach", m), "sink", capacity=int(capacity[m]), weight=0)
+
+    cost, _flow = nx.network_simplex(g)
+    return int(cost)
+
+
+def mcmf_objective(
+    n: int,
+    arcs: list,
+    supplies: dict,
+) -> int:
+    """Exact min-cost flow on a general graph.
+
+    ``arcs`` is a list of (u, v, capacity, cost); ``supplies`` maps node ->
+    net supply (positive = source).  Used as the oracle for the dense
+    general-graph kernel.
+    """
+    g = nx.DiGraph()
+    for u in range(n):
+        g.add_node(u, demand=-int(supplies.get(u, 0)))
+    for u, v, cap, cost in arcs:
+        if g.has_edge(u, v):
+            # networkx MultiDiGraph would be needed for parallel arcs; the
+            # callers never produce them.
+            raise ValueError("parallel arcs not supported by oracle")
+        g.add_edge(u, v, capacity=int(cap), weight=int(cost))
+    cost, _ = nx.network_simplex(g)
+    return int(cost)

@@ -1,0 +1,210 @@
+"""Parity tests: the TPU auction solver vs the exact host oracle.
+
+This is the core correctness gate of the whole framework (SURVEY.md section 7:
+"parity oracle standing in for cs2").  Randomized instances across shapes,
+cost ranges, scarcity regimes, and admissibility sparsity.
+"""
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.ops.transport import (
+    COST_CAP,
+    INF_COST,
+    choose_scale,
+    solve_transport,
+)
+from poseidon_tpu.solver import oracle
+
+
+def random_instance(rng, E, M, *, max_cost=200, scarcity=1.0, inadmissible=0.0):
+    costs = rng.integers(0, max_cost + 1, size=(E, M)).astype(np.int32)
+    if inadmissible > 0:
+        mask = rng.random((E, M)) < inadmissible
+        # Keep at least one admissible machine per EC so tests exercise both
+        # placement and fallback paths.
+        mask[np.arange(E), rng.integers(0, M, size=E)] = False
+        costs[mask] = INF_COST
+    supply = rng.integers(0, 8, size=E).astype(np.int32)
+    total = max(int(supply.sum()), 1)
+    cap = rng.integers(0, max(2, int(scarcity * total / max(M, 1)) * 2 + 1),
+                       size=M).astype(np.int32)
+    unsched = rng.integers(max_cost // 2, max_cost * 2 + 1, size=E).astype(np.int32)
+    unsched = np.minimum(unsched, COST_CAP).astype(np.int32)
+    return costs, supply, cap, unsched
+
+
+def check_solution_feasible(sol, costs, supply, cap):
+    assert (sol.flows >= 0).all() and (sol.unsched >= 0).all()
+    placed = sol.flows.sum(axis=1)
+    np.testing.assert_array_equal(placed + sol.unsched, supply)
+    assert (sol.flows.sum(axis=0) <= cap).all()
+    # No flow on inadmissible arcs.
+    assert sol.flows[costs >= INF_COST].sum() == 0
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_parity_small_random(seed):
+    rng = np.random.default_rng(seed)
+    E = int(rng.integers(1, 10))
+    M = int(rng.integers(1, 12))
+    costs, supply, cap, unsched = random_instance(rng, E, M)
+    sol = solve_transport(costs, supply, cap, unsched)
+    check_solution_feasible(sol, costs, supply, cap)
+    assert sol.gap_bound == 0.0  # small instance: exact scale chosen
+    expected = oracle.transport_objective(costs, supply, cap, unsched)
+    assert sol.objective == expected, (sol.objective, expected, seed)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_parity_scarce_capacity(seed):
+    """Scarcity forces heavy fallback + eviction churn."""
+    rng = np.random.default_rng(100 + seed)
+    costs, supply, cap, unsched = random_instance(
+        rng, 8, 6, max_cost=50, scarcity=0.3
+    )
+    sol = solve_transport(costs, supply, cap, unsched)
+    check_solution_feasible(sol, costs, supply, cap)
+    expected = oracle.transport_objective(costs, supply, cap, unsched)
+    assert sol.objective == expected
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_parity_with_inadmissible_arcs(seed):
+    """Selector gating: most arcs masked out."""
+    rng = np.random.default_rng(200 + seed)
+    costs, supply, cap, unsched = random_instance(
+        rng, 6, 8, max_cost=100, inadmissible=0.6
+    )
+    sol = solve_transport(costs, supply, cap, unsched)
+    check_solution_feasible(sol, costs, supply, cap)
+    expected = oracle.transport_objective(costs, supply, cap, unsched)
+    assert sol.objective == expected
+
+
+def test_parity_medium():
+    rng = np.random.default_rng(7)
+    E, M = 24, 40
+    costs, supply, cap, unsched = random_instance(rng, E, M, max_cost=500)
+    sol = solve_transport(costs, supply, cap, unsched)
+    check_solution_feasible(sol, costs, supply, cap)
+    expected = oracle.transport_objective(costs, supply, cap, unsched)
+    assert sol.objective == expected
+
+
+def test_zero_supply_and_padding():
+    """Padded rows (supply 0) and padded machines (cap 0, INF cost) are inert."""
+    costs = np.array([[5, INF_COST], [3, INF_COST]], dtype=np.int32)
+    supply = np.array([2, 0], dtype=np.int32)
+    cap = np.array([1, 0], dtype=np.int32)
+    unsched = np.array([10, 10], dtype=np.int32)
+    sol = solve_transport(costs, supply, cap, unsched)
+    # One unit placed at cost 5, one falls back at 10.
+    assert sol.objective == 15
+    assert sol.flows[0, 0] == 1 and sol.unsched[0] == 1
+    assert sol.flows[1].sum() == 0
+
+
+def test_everything_unschedulable():
+    costs = np.full((2, 3), INF_COST, dtype=np.int32)
+    supply = np.array([3, 2], dtype=np.int32)
+    cap = np.array([5, 5, 5], dtype=np.int32)
+    unsched = np.array([7, 9], dtype=np.int32)
+    sol = solve_transport(costs, supply, cap, unsched)
+    assert sol.flows.sum() == 0
+    assert sol.objective == 3 * 7 + 2 * 9
+
+
+def test_prefers_cheap_machines():
+    costs = np.array([[1, 100]], dtype=np.int32)
+    sol = solve_transport(
+        costs,
+        np.array([5], dtype=np.int32),
+        np.array([3, 10], dtype=np.int32),
+        np.array([COST_CAP], dtype=np.int32),
+    )
+    assert sol.flows[0, 0] == 3 and sol.flows[0, 1] == 2
+    assert sol.unsched[0] == 0
+
+
+def test_warm_start_prices_preserve_parity():
+    rng = np.random.default_rng(42)
+    costs, supply, cap, unsched = random_instance(rng, 6, 8)
+    sol1 = solve_transport(costs, supply, cap, unsched)
+    # Re-solve with warm prices: same optimum.
+    sol2 = solve_transport(costs, supply, cap, unsched, init_prices=sol1.prices)
+    assert sol2.objective == sol1.objective
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_warm_incremental_resolve_parity(seed):
+    """The incremental path: carry flows+prices into a perturbed instance
+    (changed costs, changed supply, shrunken capacity) with eps_start=1 and
+    still land exactly on the oracle optimum."""
+    rng = np.random.default_rng(500 + seed)
+    E, M = 8, 10
+    costs, supply, cap, unsched = random_instance(rng, E, M)
+    sol1 = solve_transport(costs, supply, cap, unsched)
+
+    costs2 = np.clip(
+        costs + rng.integers(-20, 20, size=costs.shape), 0, COST_CAP
+    ).astype(np.int32)
+    costs2[costs >= INF_COST] = INF_COST
+    supply2 = np.clip(
+        supply + rng.integers(-2, 3, size=E), 0, None
+    ).astype(np.int32)
+    cap2 = np.clip(cap + rng.integers(-2, 2, size=M), 0, None).astype(np.int32)
+
+    sol2 = solve_transport(
+        costs2, supply2, cap2, unsched,
+        init_prices=sol1.prices, init_flows=sol1.flows,
+        init_unsched=sol1.unsched, eps_start=1,
+    )
+    check_solution_feasible(sol2, costs2, supply2, cap2)
+    expected = oracle.transport_objective(costs2, supply2, cap2, unsched)
+    assert sol2.objective == expected, (seed, sol2.objective, expected)
+
+
+def test_empty_instances():
+    sol = solve_transport(
+        np.zeros((0, 3), np.int32), np.zeros(0, np.int32),
+        np.ones(3, np.int32), np.zeros(0, np.int32),
+    )
+    assert sol.objective == 0 and sol.flows.shape == (0, 3)
+    sol = solve_transport(
+        np.zeros((2, 0), np.int32), np.array([3, 1], np.int32),
+        np.zeros(0, np.int32), np.array([5, 7], np.int32),
+    )
+    assert sol.objective == 3 * 5 + 1 * 7
+    assert (sol.unsched == [3, 1]).all()
+
+
+def test_general_mcmf_oracle_matches_transport_oracle():
+    """The general-graph oracle agrees with the transportation oracle when
+    fed the same network shape (source->EC->machine->sink + fallback)."""
+    rng = np.random.default_rng(9)
+    costs, supply, cap, unsched = random_instance(rng, 4, 5)
+    expected = oracle.transport_objective(costs, supply, cap, unsched)
+    E, M = costs.shape
+    # Node ids: 0 = source, 1..E = ECs, E+1..E+M = machines, E+M+1 = sink.
+    src, sink = 0, E + M + 1
+    arcs = []
+    for e in range(E):
+        arcs.append((src, 1 + e, int(supply[e]), 0))
+        arcs.append((1 + e, sink, int(supply[e]), int(unsched[e])))
+        for m in range(M):
+            if costs[e, m] < INF_COST and cap[m] > 0:
+                arcs.append((1 + e, E + 1 + m, int(supply[e]), int(costs[e, m])))
+    for m in range(M):
+        if cap[m] > 0:
+            arcs.append((E + 1 + m, sink, int(cap[m]), 0))
+    got = oracle.mcmf_objective(
+        E + M + 2, arcs, {src: int(supply.sum()), sink: -int(supply.sum())}
+    )
+    assert got == expected
+
+
+def test_choose_scale_bounds():
+    assert choose_scale(4, 4) == 12
+    big = choose_scale(256, 100_000)
+    assert big * 4 * COST_CAP <= (1 << 30)
