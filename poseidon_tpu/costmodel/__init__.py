@@ -1,0 +1,28 @@
+"""Cost models: vectorized arc-cost kernels for the flow network.
+
+Each model maps cluster state (EC request vectors, machine capacities and
+live utilization from the knowledge base) to the dense transport instance
+the TPU solver consumes: an ``[E, M]`` int32 cost matrix (``INF_COST`` where
+inadmissible), a per-EC unscheduled cost, and per-machine slot capacity.
+
+Reference behavior being reproduced: the "multi-dimensional CPU/Memory cost
+model" that ships active in the reference deployment
+(reference README.md:53-59, deploy/firmament-deployment.yaml:29-31
+``firmament_scheduler_cpu_mem.cfg``); selector gating reproduces the
+nodeSelector -> LabelSelector vocabulary (reference
+pkg/k8sclient/podwatcher.go:455-465, label_selector.proto:23-34).
+"""
+
+from poseidon_tpu.costmodel.base import CostMatrices, CostModel, get_cost_model
+from poseidon_tpu.costmodel.cpu_mem import CpuMemCostModel
+from poseidon_tpu.costmodel.trivial import TrivialCostModel
+from poseidon_tpu.costmodel.selectors import selector_admissibility
+
+__all__ = [
+    "CostMatrices",
+    "CostModel",
+    "CpuMemCostModel",
+    "TrivialCostModel",
+    "get_cost_model",
+    "selector_admissibility",
+]

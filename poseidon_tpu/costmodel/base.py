@@ -1,0 +1,109 @@
+"""Cost-model interface and the dense tables it consumes.
+
+The graph layer flattens cluster state into two structure-of-arrays tables
+(ECTable / MachineTable) so every cost model is a pure vectorized function
+numpy -> numpy, trivially portable into the jitted solve when a model is hot
+enough to fuse (the CPU/Mem model's arithmetic is all broadcastable).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# The normalized cost range models map into.  Must stay well under the
+# solver's COST_CAP (1 << 14) including the unscheduled multiple.
+NORMALIZED_COST = 1000
+
+
+@dataclass
+class ECTable:
+    """Structure-of-arrays view of the equivalence classes in one round.
+
+    Equivalence classes collapse identical tasks into one supply node —
+    Firmament's own scalability trick (SURVEY.md section 2.2).  Tasks fall
+    into the same EC iff their request vector, selector set, task type and
+    priority are identical (see graph/ecs.py).
+    """
+
+    ec_ids: np.ndarray          # uint64 [E] stable EC hash ids
+    cpu_request: np.ndarray     # int64 [E] millicores per task
+    ram_request: np.ndarray     # int64 [E] KB per task
+    supply: np.ndarray          # int32 [E] number of tasks to place
+    priority: np.ndarray        # int32 [E]
+    task_type: np.ndarray       # int32 [E] SHEEP/RABBIT/DEVIL/TURTLE
+    max_wait_rounds: np.ndarray  # int32 [E] max rounds any member has waited
+    # Per-EC selector list: (type, key, values) tuples, canonical order.
+    selectors: List[Tuple[Tuple[int, str, Tuple[str, ...]], ...]] = field(
+        default_factory=list
+    )
+
+    @property
+    def num_ecs(self) -> int:
+        return int(self.ec_ids.shape[0])
+
+
+@dataclass
+class MachineTable:
+    """Structure-of-arrays view of schedulable machines in one round."""
+
+    uuids: List[str]            # [M] machine resource uuids
+    cpu_capacity: np.ndarray    # int64 [M] millicores
+    ram_capacity: np.ndarray    # int64 [M] KB
+    cpu_used: np.ndarray        # int64 [M] millicores committed (placed tasks)
+    ram_used: np.ndarray        # int64 [M] KB committed
+    cpu_util: np.ndarray        # float32 [M] measured utilization 0..1 (KB)
+    mem_util: np.ndarray        # float32 [M] measured utilization 0..1
+    slots_free: np.ndarray      # int32 [M] free task slots
+    labels: List[Dict[str, str]] = field(default_factory=list)
+
+    @property
+    def num_machines(self) -> int:
+        return len(self.uuids)
+
+
+@dataclass
+class CostMatrices:
+    """What the solver consumes.  costs uses INF_COST for inadmissible arcs.
+
+    arc_capacity bounds how many units of EC e machine m can hold — the
+    flow formulation's handle on multi-dimensional fit (the upstream
+    cpu_mem model bounds its EC->machine arcs the same way).
+    """
+
+    costs: np.ndarray           # int32 [E, M]
+    unsched_cost: np.ndarray    # int32 [E]
+    capacity: np.ndarray        # int32 [M] machine slot capacity
+    arc_capacity: Optional[np.ndarray] = None  # int32 [E, M]
+
+
+class CostModel:
+    """Interface: a pure function of the round's tables."""
+
+    name: str = "base"
+
+    def build(self, ecs: ECTable, machines: MachineTable) -> CostMatrices:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def get_cost_model(name: str, **kwargs) -> CostModel:
+    """Cost-model selection by flag, the analog of Firmament's
+    ``--flagfile=...cpu_mem.cfg`` model switch (reference
+    deploy/firmament-deployment.yaml:29-31)."""
+    try:
+        cls = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown cost model {name!r}; have {sorted(_REGISTRY)}"
+        ) from None
+    return cls(**kwargs)

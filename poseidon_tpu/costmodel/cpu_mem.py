@@ -1,0 +1,130 @@
+"""The multi-dimensional CPU/Memory cost model.
+
+Reproduces the behavior of the reference deployment's active cost model
+(reference README.md:53-59 "multi-dimensional CPU/Memory cost model";
+selected by ``firmament_scheduler_cpu_mem.cfg``,
+deploy/firmament-deployment.yaml:29-31).  Behavioral contract:
+
+- an EC->machine arc exists only if the task's request fits the machine's
+  *currently unreserved* capacity in every dimension and the EC's selectors
+  admit the machine (node-level affinity, reference roadmap release 0.2);
+- arc cost grows with the machine's load after placement, averaged over the
+  CPU and memory dimensions, so the solve spreads load / picks the least
+  loaded machines first and the flow optimum matches the "globally optimal
+  for a given policy" claim (README.md:26);
+- measured utilization from the knowledge base (AddNodeStats round-trip) is
+  blended with request-based reservation so chronically hot machines price
+  themselves out even when reservations look light;
+- the unscheduled fallback cost rises with how many rounds the EC's tasks
+  have waited, bounding starvation (Firmament's unscheduled-aggregator cost
+  scales with wait time the same way).
+
+All arithmetic is broadcastable [E,1]x[1,M] numpy; no Python loops over
+arcs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from poseidon_tpu.costmodel import base
+from poseidon_tpu.costmodel.selectors import selector_admissibility
+from poseidon_tpu.ops.transport import INF_COST
+
+
+@base.register
+@dataclass
+class CpuMemCostModel(base.CostModel):
+    name = "cpu_mem"
+
+    # Blend between reservation-based load (requests) and measured load
+    # (knowledge-base utilization).
+    measured_weight: float = 0.25
+    # Relative weight of the CPU dimension vs memory.
+    cpu_weight: float = 0.5
+    # Unscheduled cost: base multiple of the normalized cost range plus a
+    # per-wait-round escalator.
+    unsched_base: int = 2 * base.NORMALIZED_COST
+    unsched_per_round: int = base.NORMALIZED_COST // 4
+
+    def build(
+        self, ecs: base.ECTable, machines: base.MachineTable
+    ) -> base.CostMatrices:
+        E, M = ecs.num_ecs, machines.num_machines
+        unsched = (
+            self.unsched_base
+            + self.unsched_per_round * ecs.max_wait_rounds.astype(np.int64)
+        )
+        unsched = np.clip(unsched, 0, 8 * base.NORMALIZED_COST).astype(np.int32)
+        if E == 0 or M == 0:
+            # No arcs to price, but the starvation escalator still applies
+            # (a machineless round must not report zero unscheduled cost).
+            return base.CostMatrices(
+                costs=np.zeros((E, M), dtype=np.int32),
+                unsched_cost=unsched,
+                capacity=machines.slots_free.astype(np.int32),
+                arc_capacity=np.zeros((E, M), dtype=np.int32),
+            )
+
+        cpu_cap = np.maximum(machines.cpu_capacity.astype(np.float64), 1.0)
+        ram_cap = np.maximum(machines.ram_capacity.astype(np.float64), 1.0)
+        cpu_req = ecs.cpu_request.astype(np.float64)[:, None]      # [E,1]
+        ram_req = ecs.ram_request.astype(np.float64)[:, None]
+
+        # Fit: request must fit what is not already committed to placed
+        # tasks.  (Measured utilization does not gate fit — reservations
+        # do, as in the reference's reservation-based admission.)
+        cpu_free = (machines.cpu_capacity - machines.cpu_used).astype(
+            np.float64
+        )[None, :]
+        ram_free = (machines.ram_capacity - machines.ram_used).astype(
+            np.float64
+        )[None, :]
+        fits = (cpu_req <= cpu_free) & (ram_req <= ram_free)
+
+        admissible = fits & selector_admissibility(
+            ecs.selectors, machines.labels
+        )
+
+        # Per-arc capacity: how many tasks of EC e fit machine m's free
+        # resources simultaneously (min over dimensions).  This is the
+        # flow network's multi-dimensional packing bound.
+        with np.errstate(divide="ignore", invalid="ignore"):
+            n_cpu = np.where(
+                cpu_req > 0, np.floor(cpu_free / np.maximum(cpu_req, 1e-9)),
+                np.inf,
+            )
+            n_ram = np.where(
+                ram_req > 0, np.floor(ram_free / np.maximum(ram_req, 1e-9)),
+                np.inf,
+            )
+        n_fit = np.minimum(n_cpu, n_ram)
+        n_fit = np.where(np.isfinite(n_fit), n_fit, np.iinfo(np.int32).max // 4)
+        arc_cap = np.where(admissible, n_fit, 0).astype(np.int32)
+
+        # Load after placement, per dimension, blending reserved and
+        # measured load.
+        w = float(self.measured_weight)
+        cpu_load = (
+            (1.0 - w) * (machines.cpu_used[None, :] + cpu_req) / cpu_cap[None, :]
+            + w * machines.cpu_util.astype(np.float64)[None, :]
+        )
+        mem_load = (
+            (1.0 - w) * (machines.ram_used[None, :] + ram_req) / ram_cap[None, :]
+            + w * machines.mem_util.astype(np.float64)[None, :]
+        )
+        wc = float(self.cpu_weight)
+        load = wc * cpu_load + (1.0 - wc) * mem_load
+        costs = np.clip(
+            np.rint(load * base.NORMALIZED_COST), 0, 4 * base.NORMALIZED_COST
+        ).astype(np.int32)
+        costs = np.where(admissible, costs, INF_COST).astype(np.int32)
+
+        return base.CostMatrices(
+            costs=costs,
+            unsched_cost=unsched,
+            capacity=machines.slots_free.astype(np.int32),
+            arc_capacity=arc_cap,
+        )

@@ -1,0 +1,79 @@
+"""Vectorized label-selector admissibility.
+
+Turns the IN_SET / NOT_IN_SET / EXISTS_KEY / NOT_EXISTS_KEY selector
+vocabulary (reference label_selector.proto:23-34; produced from K8s
+nodeSelector maps by the pod watcher, podwatcher.go:455-465) into a boolean
+``[E, M]`` admissibility mask without per-(EC, machine) Python loops:
+machine labels are interned into (key, key=value) id spaces once per round,
+then each distinct selector is one numpy membership test over machines.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+# Selector type codes, matching LabelSelector.SelectorType wire values.
+IN_SET = 0
+NOT_IN_SET = 1
+EXISTS_KEY = 2
+NOT_EXISTS_KEY = 3
+
+Selector = Tuple[int, str, Tuple[str, ...]]
+
+
+def selector_admissibility(
+    ec_selectors: Sequence[Tuple[Selector, ...]],
+    machine_labels: Sequence[Dict[str, str]],
+) -> np.ndarray:
+    """Boolean [E, M]: True where EC e may run on machine m.
+
+    Semantics per selector (all must hold — conjunction, as with K8s
+    nodeSelector):
+      IN_SET:         machine has key and its value is in `values`
+      NOT_IN_SET:     machine lacks key, or its value is not in `values`
+      EXISTS_KEY:     machine has key
+      NOT_EXISTS_KEY: machine lacks key
+    """
+    E = len(ec_selectors)
+    M = len(machine_labels)
+    mask = np.ones((E, M), dtype=bool)
+    if E == 0 or M == 0:
+        return mask
+
+    # Distinct selectors across ECs (jobs share selector sets, so this is
+    # tiny); evaluate each once over all machines.
+    distinct: Dict[Selector, np.ndarray] = {}
+    for sels in ec_selectors:
+        for sel in sels:
+            if sel not in distinct:
+                distinct[sel] = _eval_selector(sel, machine_labels)
+
+    for e, sels in enumerate(ec_selectors):
+        for sel in sels:
+            mask[e] &= distinct[sel]
+    return mask
+
+
+def _eval_selector(
+    sel: Selector, machine_labels: Sequence[Dict[str, str]]
+) -> np.ndarray:
+    stype, key, values = sel
+    M = len(machine_labels)
+    has_key = np.fromiter(
+        (key in lb for lb in machine_labels), dtype=bool, count=M
+    )
+    if stype == EXISTS_KEY:
+        return has_key
+    if stype == NOT_EXISTS_KEY:
+        return ~has_key
+    vset = set(values)
+    in_set = np.fromiter(
+        (lb.get(key) in vset for lb in machine_labels), dtype=bool, count=M
+    )
+    if stype == IN_SET:
+        return in_set
+    if stype == NOT_IN_SET:
+        return ~in_set
+    raise ValueError(f"unknown selector type {stype}")

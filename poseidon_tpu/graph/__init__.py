@@ -1,0 +1,33 @@
+"""Flow-graph manager: cluster state -> dense transport instances -> deltas.
+
+This is the host-side half of the scheduler core.  It owns the task/job/
+machine state machines (with the exact reply-enum semantics the Poseidon
+client fatally checks, reference pkg/firmament/firmament_client.go:29-221),
+collapses tasks into equivalence classes, builds the dense cost/supply/
+capacity arrays the TPU solver consumes, and diffs successive solutions
+into SchedulingDeltas (PLACE / PREEMPT / MIGRATE).
+"""
+
+from poseidon_tpu.graph.ecs import ec_signature
+from poseidon_tpu.graph.state import (
+    ClusterState,
+    MachineInfo,
+    NodeReply,
+    TaskInfo,
+    TaskReply,
+    TaskState,
+)
+from poseidon_tpu.graph.instance import Delta, DeltaType, RoundPlanner
+
+__all__ = [
+    "ClusterState",
+    "Delta",
+    "DeltaType",
+    "MachineInfo",
+    "NodeReply",
+    "RoundPlanner",
+    "TaskInfo",
+    "TaskReply",
+    "TaskState",
+    "ec_signature",
+]

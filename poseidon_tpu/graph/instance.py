@@ -1,0 +1,297 @@
+"""RoundPlanner: one `Schedule()` round, state -> TPU solve -> deltas.
+
+The round pipeline (the TPU-native re-design of Firmament's
+flow_graph_manager + solver dispatch; reference contract
+firmament_scheduler.proto:15-45, delta vocabulary scheduling_delta.proto:24-40):
+
+1. snapshot the schedulable world (runnable + running tasks, healthy
+   machines) from ClusterState;
+2. collapse tasks into equivalence classes (graph/ecs.py) -> ECTable, pack
+   machines -> MachineTable (stable sort orders so warm starts carry over);
+3. run the configured cost model -> dense [E, M] cost/capacity arrays;
+4. solve the transportation problem on TPU (ops/transport.py), warm-started
+   from the previous round's prices and flows keyed by EC id / machine uuid;
+5. turn EC-level flows into per-task assignments, preferring to keep each
+   task where it already runs (placement stability minimizes MIGRATEs);
+6. diff against previous placements -> SchedulingDeltas (PLACE / PREEMPT /
+   MIGRATE; NOOPs are elided exactly as the reference client skips them,
+   cmd/poseidon/poseidon.go:64) and commit the new placements to state.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from poseidon_tpu.costmodel.base import CostModel, ECTable, MachineTable
+from poseidon_tpu.graph.state import ClusterState, TaskInfo, TaskState
+from poseidon_tpu.ops.transport import solve_transport
+
+
+class DeltaType(enum.IntEnum):
+    """SchedulingDelta.ChangeType wire values (scheduling_delta.proto:26-31)."""
+
+    NOOP = 0
+    PLACE = 1
+    PREEMPT = 2
+    MIGRATE = 3
+
+
+@dataclass
+class Delta:
+    task_id: int
+    resource_id: str  # machine uuid ("" for PREEMPT)
+    type: DeltaType
+
+
+@dataclass
+class RoundMetrics:
+    """Per-round observability (the BASELINE metrics: solve latency and
+    placement cost; SURVEY.md section 5 'add per-round solve-latency and
+    cost-objective metrics')."""
+
+    round_index: int = 0
+    num_tasks: int = 0
+    num_ecs: int = 0
+    num_machines: int = 0
+    solve_seconds: float = 0.0
+    total_seconds: float = 0.0
+    objective: int = 0
+    gap_bound: float = 0.0
+    iterations: int = 0
+    placed: int = 0
+    preempted: int = 0
+    migrated: int = 0
+    unscheduled: int = 0
+
+
+@dataclass
+class _WarmState:
+    ec_ids: List[int] = field(default_factory=list)
+    machine_uuids: List[str] = field(default_factory=list)
+    prices: Optional[np.ndarray] = None
+    flows: Optional[np.ndarray] = None
+    unsched: Optional[np.ndarray] = None
+
+
+class RoundPlanner:
+    """Owns the solve path; one instance per service process."""
+
+    def __init__(
+        self,
+        state: ClusterState,
+        cost_model: CostModel,
+        *,
+        preemption: bool = True,
+    ) -> None:
+        self.state = state
+        self.cost_model = cost_model
+        self.preemption = preemption
+        self._warm = _WarmState()
+        self.last_metrics = RoundMetrics()
+
+    # ------------------------------------------------------------ table build
+
+    def _build_tables(
+        self, tasks: List[TaskInfo], machines
+    ) -> Tuple[ECTable, MachineTable, Dict[int, List[TaskInfo]]]:
+        by_ec: Dict[int, List[TaskInfo]] = {}
+        for t in tasks:
+            by_ec.setdefault(t.ec_id, []).append(t)
+        ec_ids = sorted(by_ec)
+        reps = [by_ec[e][0] for e in ec_ids]
+        ecs = ECTable(
+            ec_ids=np.array(ec_ids, dtype=np.uint64),
+            cpu_request=np.array([r.cpu_request for r in reps], dtype=np.int64),
+            ram_request=np.array([r.ram_request for r in reps], dtype=np.int64),
+            supply=np.array([len(by_ec[e]) for e in ec_ids], dtype=np.int32),
+            priority=np.array([r.priority for r in reps], dtype=np.int32),
+            task_type=np.array([r.task_type for r in reps], dtype=np.int32),
+            max_wait_rounds=np.array(
+                [max(t.wait_rounds for t in by_ec[e]) for e in ec_ids],
+                dtype=np.int32,
+            ),
+            selectors=[r.selectors for r in reps],
+        )
+        machines = sorted(machines, key=lambda m: m.uuid)
+        mt = MachineTable(
+            uuids=[m.uuid for m in machines],
+            cpu_capacity=np.array([m.cpu_capacity for m in machines], np.int64),
+            ram_capacity=np.array([m.ram_capacity for m in machines], np.int64),
+            # The full re-solve assigns every task fresh each round, so no
+            # resources are pre-committed outside the solve.
+            cpu_used=np.zeros(len(machines), dtype=np.int64),
+            ram_used=np.zeros(len(machines), dtype=np.int64),
+            cpu_util=np.array([m.cpu_util for m in machines], np.float32),
+            mem_util=np.array([m.mem_util for m in machines], np.float32),
+            slots_free=np.array([m.task_slots for m in machines], np.int32),
+            labels=[m.labels for m in machines],
+        )
+        return ecs, mt, by_ec
+
+    # ------------------------------------------------------------- warm start
+
+    def _remap_warm(
+        self, ec_ids: List[int], machine_uuids: List[str]
+    ) -> Tuple[Optional[np.ndarray], Optional[np.ndarray], Optional[np.ndarray]]:
+        """Carry prices/flows from the previous round into this round's
+        index space (ECs/machines may have churned)."""
+        w = self._warm
+        if w.prices is None:
+            return None, None, None
+        E, M = len(ec_ids), len(machine_uuids)
+        prev_e = {e: i for i, e in enumerate(w.ec_ids)}
+        prev_m = {u: i for i, u in enumerate(w.machine_uuids)}
+        prices = np.zeros(E + M + 1, dtype=np.int32)
+        prices[E + M] = w.prices[len(w.ec_ids) + len(w.machine_uuids)]
+        flows = np.zeros((E, M), dtype=np.int32)
+        unsched = np.zeros(E, dtype=np.int32)
+        # Vectorized gather of the surviving rows/columns (this runs every
+        # round; a Python E*M loop would dwarf the solve at scale).
+        e_idx = np.array([prev_e.get(e, -1) for e in ec_ids], dtype=np.int64)
+        m_idx = np.array(
+            [prev_m.get(u, -1) for u in machine_uuids], dtype=np.int64
+        )
+        ke_new = np.nonzero(e_idx >= 0)[0]
+        km_new = np.nonzero(m_idx >= 0)[0]
+        ke_old = e_idx[ke_new]
+        km_old = m_idx[km_new]
+        prices[ke_new] = w.prices[ke_old]
+        prices[E + km_new] = w.prices[len(w.ec_ids) + km_old]
+        if w.unsched is not None:
+            unsched[ke_new] = w.unsched[ke_old]
+        if w.flows is not None and ke_new.size and km_new.size:
+            flows[np.ix_(ke_new, km_new)] = w.flows[np.ix_(ke_old, km_old)]
+        return prices, flows, unsched
+
+    # ------------------------------------------------------------------ round
+
+    def schedule_round(self) -> Tuple[List[Delta], RoundMetrics]:
+        t0 = time.perf_counter()
+        st = self.state
+        tasks, machines, _gen = st.snapshot()
+        metrics = RoundMetrics(
+            round_index=st.round_index,
+            num_tasks=len(tasks),
+            num_machines=len(machines),
+        )
+        if not tasks:
+            st.round_index += 1
+            metrics.total_seconds = time.perf_counter() - t0
+            self.last_metrics = metrics
+            return [], metrics
+
+        ecs, mt, by_ec = self._build_tables(tasks, machines)
+        metrics.num_ecs = ecs.num_ecs
+        cm = self.cost_model.build(ecs, mt)
+
+        prices, flows0, unsched0 = self._remap_warm(
+            list(ecs.ec_ids.tolist()), mt.uuids
+        )
+        t_solve = time.perf_counter()
+        sol = solve_transport(
+            cm.costs,
+            ecs.supply,
+            cm.capacity,
+            cm.unsched_cost,
+            prices,
+            arc_capacity=cm.arc_capacity,
+            init_flows=flows0,
+            init_unsched=unsched0,
+        )
+        metrics.solve_seconds = time.perf_counter() - t_solve
+        metrics.objective = sol.objective
+        metrics.gap_bound = sol.gap_bound
+        metrics.iterations = sol.iterations
+
+        self._warm = _WarmState(
+            ec_ids=list(ecs.ec_ids.tolist()),
+            machine_uuids=list(mt.uuids),
+            prices=sol.prices,
+            flows=sol.flows,
+            unsched=sol.unsched,
+        )
+
+        deltas = self._assign(sol.flows, ecs, mt, by_ec, metrics)
+        st.round_index += 1
+        metrics.total_seconds = time.perf_counter() - t0
+        self.last_metrics = metrics
+        return deltas, metrics
+
+    # -------------------------------------------------------------- assignment
+
+    def _assign(
+        self,
+        flows: np.ndarray,
+        ecs: ECTable,
+        mt: MachineTable,
+        by_ec: Dict[int, List[TaskInfo]],
+        metrics: RoundMetrics,
+    ) -> List[Delta]:
+        """EC-level flows -> per-task placements, stability-first."""
+        deltas: List[Delta] = []
+        st = self.state
+        uuid_to_col = {u: j for j, u in enumerate(mt.uuids)}
+
+        for i, ec in enumerate(ecs.ec_ids.tolist()):
+            members = sorted(by_ec[ec], key=lambda t: t.uid)
+            want: Dict[int, int] = {
+                j: int(flows[i, j]) for j in range(len(mt.uuids)) if flows[i, j]
+            }
+            assigned: Dict[int, int] = {}  # uid -> column
+            pool: List[TaskInfo] = []
+
+            # Pass 1: keep tasks where they already run if the solution
+            # still routes flow there.
+            for t in members:
+                col = uuid_to_col.get(t.scheduled_to) if t.scheduled_to else None
+                if col is not None and want.get(col, 0) > 0:
+                    assigned[t.uid] = col
+                    want[col] -= 1
+                else:
+                    pool.append(t)
+
+            # Pass 2: longest-waiting first among the remainder (bounded
+            # unfairness; ties broken by uid for determinism).
+            pool.sort(key=lambda t: (-t.wait_rounds, t.uid))
+            remaining: List[Tuple[int, int]] = [
+                (j, want[j]) for j in sorted(want) if want[j] > 0
+            ]
+            ri = 0
+            for t in pool:
+                while ri < len(remaining) and remaining[ri][1] == 0:
+                    ri += 1
+                if ri >= len(remaining):
+                    assigned[t.uid] = -1  # unscheduled
+                else:
+                    j, n = remaining[ri]
+                    assigned[t.uid] = j
+                    remaining[ri] = (j, n - 1)
+
+            for t in members:
+                col = assigned[t.uid]
+                new_uuid = mt.uuids[col] if col >= 0 else None
+                old_uuid = t.scheduled_to
+                if new_uuid == old_uuid:
+                    if new_uuid is None:
+                        metrics.unscheduled += 1
+                        st.apply_placement(t.uid, None)
+                    continue
+                if old_uuid is None:
+                    deltas.append(Delta(t.uid, new_uuid, DeltaType.PLACE))
+                    metrics.placed += 1
+                elif new_uuid is None:
+                    if not self.preemption:
+                        # Preemption disabled: leave the task in place.
+                        continue
+                    deltas.append(Delta(t.uid, "", DeltaType.PREEMPT))
+                    metrics.preempted += 1
+                else:
+                    deltas.append(Delta(t.uid, new_uuid, DeltaType.MIGRATE))
+                    metrics.migrated += 1
+                st.apply_placement(t.uid, new_uuid)
+        return deltas

@@ -1,0 +1,359 @@
+"""Cluster state: the task/job/machine state machines behind the 13 RPCs.
+
+Reply semantics are load-bearing: the Poseidon client ``glog.Fatalf``s on
+NOT_FOUND / ALREADY_EXISTS / STATE_NOT_CREATED answers (reference
+pkg/firmament/firmament_client.go:44-50 et al.), so this module answers
+exactly as Firmament's state machine would:
+
+- TaskSubmitted: known uid -> TASK_ALREADY_SUBMITTED; task in any state but
+  CREATED cannot be (re)submitted -> TASK_STATE_NOT_CREATED; else OK.
+- TaskCompleted/Failed/Removed/Updated on an unknown uid -> TASK_NOT_FOUND.
+- NodeAdded on a known uuid -> NODE_ALREADY_EXISTS; Failed/Removed/Updated
+  on an unknown uuid -> NODE_NOT_FOUND.
+
+Machine bookkeeping: Poseidon emits a 2-level Machine -> PU#0 topology
+(reference nodewatcher.go:292-339); we register every node of the subtree
+in the uuid index (so stats addressed to either level resolve) but account
+capacity at machine granularity, which is exactly the information content
+of the reference's degenerate one-PU topology.
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from poseidon_tpu.graph.ecs import Selector, canonical_selectors, ec_signature
+
+
+class TaskReply(enum.IntEnum):
+    """TaskReplyType wire values (firmament_scheduler.proto:110-120)."""
+
+    COMPLETED_OK = 0
+    SUBMITTED_OK = 1
+    REMOVED_OK = 2
+    FAILED_OK = 3
+    UPDATED_OK = 4
+    NOT_FOUND = 5
+    JOB_NOT_FOUND = 6
+    ALREADY_SUBMITTED = 7
+    STATE_NOT_CREATED = 8
+
+
+class NodeReply(enum.IntEnum):
+    """NodeReplyType wire values (firmament_scheduler.proto:122-129)."""
+
+    ADDED_OK = 0
+    FAILED_OK = 1
+    REMOVED_OK = 2
+    UPDATED_OK = 3
+    NOT_FOUND = 4
+    ALREADY_EXISTS = 5
+
+
+class TaskState(enum.IntEnum):
+    """Task lifecycle (task_desc.proto:32-43 subset the service drives)."""
+
+    CREATED = 0
+    RUNNABLE = 2
+    ASSIGNED = 3
+    RUNNING = 4
+    COMPLETED = 5
+    FAILED = 6
+    ABORTED = 7
+
+
+# Default task slots per machine when the descriptor does not carry
+# task_capacity.  Firmament's one-PU topology from Poseidon gives no slot
+# count; bounding concurrent tasks per machine keeps the transport column
+# capacities meaningful.
+DEFAULT_TASK_SLOTS = 100
+
+_STATS_WINDOW = 64  # knowledge-base ring-buffer depth per entity
+
+
+@dataclass
+class TaskInfo:
+    uid: int
+    job_id: str
+    name: str = ""
+    cpu_request: int = 0       # millicores
+    ram_request: int = 0       # KB
+    priority: int = 0
+    task_type: int = 0
+    selectors: Tuple[Selector, ...] = ()
+    labels: Dict[str, str] = field(default_factory=dict)
+    state: TaskState = TaskState.RUNNABLE
+    # Machine uuid this task is currently placed on (None = unscheduled).
+    scheduled_to: Optional[str] = None
+    submit_round: int = 0
+    wait_rounds: int = 0
+    # Cluster-trace replay hooks (task_desc.proto:98-99).
+    trace_job_id: int = 0
+    trace_task_id: int = 0
+
+    @property
+    def ec_id(self) -> int:
+        return ec_signature(
+            self.cpu_request,
+            self.ram_request,
+            self.selectors,
+            self.task_type,
+            self.priority,
+        )
+
+
+@dataclass
+class MachineInfo:
+    uuid: str
+    hostname: str = ""
+    cpu_capacity: int = 0      # millicores
+    ram_capacity: int = 0      # KB
+    task_slots: int = DEFAULT_TASK_SLOTS
+    labels: Dict[str, str] = field(default_factory=dict)
+    healthy: bool = True
+    # uuids of every resource in this machine's topology subtree (PUs...).
+    subtree_uuids: Set[str] = field(default_factory=set)
+    # Measured utilization from the knowledge base (EMA over AddNodeStats).
+    cpu_util: float = 0.0
+    mem_util: float = 0.0
+    trace_machine_id: int = 0
+
+
+@dataclass
+class _KBEntry:
+    samples: deque = field(default_factory=lambda: deque(maxlen=_STATS_WINDOW))
+
+
+class ClusterState:
+    """The mutable cluster model; thread-safe (the gRPC server is
+    multi-threaded, matching the reference's concurrent watcher RPCs)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self.tasks: Dict[int, TaskInfo] = {}
+        self.jobs: Dict[str, Set[int]] = {}
+        self.machines: Dict[str, MachineInfo] = {}
+        # Any-resource-uuid -> machine uuid (PUs resolve to their machine).
+        self.resource_to_machine: Dict[str, str] = {}
+        self.task_kb: Dict[int, _KBEntry] = {}
+        self.node_kb: Dict[str, _KBEntry] = {}
+        self.round_index = 0
+        # Monotonic generation, bumped on every mutation; lets the planner
+        # skip rebuild work on quiet rounds.
+        self.generation = 0
+
+    # ------------------------------------------------------------------ tasks
+
+    def task_submitted(self, task: TaskInfo) -> TaskReply:
+        with self._lock:
+            existing = self.tasks.get(task.uid)
+            if existing is not None:
+                if existing.state in (TaskState.RUNNABLE, TaskState.CREATED):
+                    return TaskReply.ALREADY_SUBMITTED
+                return TaskReply.STATE_NOT_CREATED
+            task.state = TaskState.RUNNABLE
+            task.submit_round = self.round_index
+            self.tasks[task.uid] = task
+            self.jobs.setdefault(task.job_id, set()).add(task.uid)
+            self.generation += 1
+            return TaskReply.SUBMITTED_OK
+
+    def _finish_task(self, uid: int, state: TaskState) -> Optional[TaskInfo]:
+        task = self.tasks.get(uid)
+        if task is None:
+            return None
+        task.state = state
+        task.scheduled_to = None
+        self.generation += 1
+        return task
+
+    def task_completed(self, uid: int) -> TaskReply:
+        with self._lock:
+            if self._finish_task(uid, TaskState.COMPLETED) is None:
+                return TaskReply.NOT_FOUND
+            return TaskReply.COMPLETED_OK
+
+    def task_failed(self, uid: int) -> TaskReply:
+        with self._lock:
+            task = self.tasks.get(uid)
+            if task is None:
+                return TaskReply.NOT_FOUND
+            # FAILED is terminal for this uid: the replacement pod arrives
+            # as a *new* task (the reference's controller recreates the pod
+            # and the watcher derives a fresh uid, podwatcher.go:310-318);
+            # the failed task itself is later TaskRemoved.
+            task.state = TaskState.FAILED
+            task.scheduled_to = None
+            self.generation += 1
+            return TaskReply.FAILED_OK
+
+    def task_removed(self, uid: int) -> TaskReply:
+        with self._lock:
+            task = self.tasks.pop(uid, None)
+            if task is None:
+                return TaskReply.NOT_FOUND
+            members = self.jobs.get(task.job_id)
+            if members is not None:
+                members.discard(uid)
+                if not members:
+                    del self.jobs[task.job_id]  # job GC, podwatcher.go:288-309
+            self.task_kb.pop(uid, None)
+            self.generation += 1
+            return TaskReply.REMOVED_OK
+
+    def task_updated(self, task: TaskInfo) -> TaskReply:
+        with self._lock:
+            existing = self.tasks.get(task.uid)
+            if existing is None:
+                return TaskReply.NOT_FOUND
+            # Update the mutable request/constraint attributes in place
+            # (podwatcher.go:362-375 updates request + labels).
+            existing.cpu_request = task.cpu_request
+            existing.ram_request = task.ram_request
+            existing.priority = task.priority
+            existing.task_type = task.task_type
+            existing.selectors = task.selectors
+            existing.labels = task.labels
+            self.generation += 1
+            return TaskReply.UPDATED_OK
+
+    # ---------------------------------------------------------------- machines
+
+    def node_added(self, machine: MachineInfo) -> NodeReply:
+        with self._lock:
+            if machine.uuid in self.machines:
+                return NodeReply.ALREADY_EXISTS
+            self.machines[machine.uuid] = machine
+            self.resource_to_machine[machine.uuid] = machine.uuid
+            for sub in machine.subtree_uuids:
+                self.resource_to_machine[sub] = machine.uuid
+            self.generation += 1
+            return NodeReply.ADDED_OK
+
+    def _evict_tasks_on(self, machine_uuid: str) -> List[int]:
+        evicted = []
+        for task in self.tasks.values():
+            if task.scheduled_to == machine_uuid:
+                task.scheduled_to = None
+                task.state = TaskState.RUNNABLE
+                evicted.append(task.uid)
+        return evicted
+
+    def node_failed(self, uuid: str) -> NodeReply:
+        with self._lock:
+            machine_uuid = self.resource_to_machine.get(uuid)
+            machine = self.machines.get(machine_uuid) if machine_uuid else None
+            if machine is None:
+                return NodeReply.NOT_FOUND
+            machine.healthy = False
+            # Tasks on a failed node go back to runnable; the next round
+            # re-places them (failure propagation, nodewatcher.go:151-165).
+            self._evict_tasks_on(machine.uuid)
+            self.generation += 1
+            return NodeReply.FAILED_OK
+
+    def node_removed(self, uuid: str) -> NodeReply:
+        with self._lock:
+            machine_uuid = self.resource_to_machine.get(uuid)
+            machine = (
+                self.machines.pop(machine_uuid, None) if machine_uuid else None
+            )
+            if machine is None:
+                return NodeReply.NOT_FOUND
+            self.resource_to_machine.pop(machine.uuid, None)
+            for sub in machine.subtree_uuids:
+                self.resource_to_machine.pop(sub, None)
+            self.node_kb.pop(machine.uuid, None)
+            self._evict_tasks_on(machine.uuid)
+            self.generation += 1
+            return NodeReply.REMOVED_OK
+
+    def node_updated(self, machine: MachineInfo) -> NodeReply:
+        with self._lock:
+            existing = self.machines.get(machine.uuid)
+            if existing is None:
+                return NodeReply.NOT_FOUND
+            existing.cpu_capacity = machine.cpu_capacity
+            existing.ram_capacity = machine.ram_capacity
+            existing.labels = machine.labels
+            existing.hostname = machine.hostname or existing.hostname
+            existing.healthy = True
+            for sub in machine.subtree_uuids:
+                existing.subtree_uuids.add(sub)
+                self.resource_to_machine[sub] = existing.uuid
+            self.generation += 1
+            return NodeReply.UPDATED_OK
+
+    # ------------------------------------------------------------------ stats
+
+    def add_task_stats(self, uid: int, sample: dict) -> TaskReply:
+        with self._lock:
+            if uid not in self.tasks:
+                return TaskReply.NOT_FOUND
+            self.task_kb.setdefault(uid, _KBEntry()).samples.append(sample)
+            return TaskReply.SUBMITTED_OK
+
+    def add_node_stats(self, resource_uuid: str, sample: dict) -> NodeReply:
+        with self._lock:
+            machine_uuid = self.resource_to_machine.get(resource_uuid)
+            machine = self.machines.get(machine_uuid) if machine_uuid else None
+            if machine is None:
+                return NodeReply.NOT_FOUND
+            self.node_kb.setdefault(machine.uuid, _KBEntry()).samples.append(
+                sample
+            )
+            # EMA blend into the live utilization signal the cost model reads.
+            alpha = 0.5
+            cpu_u = sample.get("cpu_utilization")
+            mem_u = sample.get("mem_utilization")
+            if cpu_u is not None:
+                machine.cpu_util = (
+                    alpha * float(cpu_u) + (1 - alpha) * machine.cpu_util
+                )
+            if mem_u is not None:
+                machine.mem_util = (
+                    alpha * float(mem_u) + (1 - alpha) * machine.mem_util
+                )
+            self.generation += 1
+            return NodeReply.ADDED_OK
+
+    # ------------------------------------------------------------- placements
+
+    def apply_placement(self, uid: int, machine_uuid: Optional[str]) -> None:
+        """Record the outcome of a round for one task."""
+        with self._lock:
+            task = self.tasks.get(uid)
+            if task is None:
+                return
+            task.scheduled_to = machine_uuid
+            if machine_uuid is None:
+                task.state = TaskState.RUNNABLE
+                task.wait_rounds += 1
+            else:
+                task.state = TaskState.RUNNING
+                task.wait_rounds = 0
+            self.generation += 1
+
+    def snapshot(self):
+        """Consistent copy of the schedulable world for one round.
+
+        Returns shallow copies of the task/machine records so concurrent
+        RPC threads mutating the live objects cannot tear the planner's
+        view mid-round (updates replace attribute references rather than
+        mutating nested structures, so shallow copies suffice).
+        """
+        with self._lock:
+            runnable = [
+                copy.copy(t)
+                for t in self.tasks.values()
+                if t.state in (TaskState.RUNNABLE, TaskState.RUNNING)
+            ]
+            machines = [
+                copy.copy(m) for m in self.machines.values() if m.healthy
+            ]
+            return runnable, machines, self.generation

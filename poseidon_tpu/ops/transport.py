@@ -341,12 +341,14 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, J, max_iter):
 
 
 @functools.partial(jax.jit, static_argnames=("J", "max_iter", "scale"))
-def _solve_device(costs, supply, capacity, unsched_cost, init_prices,
+def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
                   init_flows, init_fb, eps_sched, *, J, max_iter, scale):
     """The jitted solve.  All inputs int32; shapes static.
 
     costs: [E, M] raw costs (INF_COST where inadmissible)
     supply: [E]; capacity: [M]; unsched_cost: [E]
+    arc_cap: [E, M] per-arc capacity (units of EC e machine m can hold —
+      the cpu_mem cost model's fit bound; pass a large constant to disable)
     init_prices: [E+M+1] warm-start potentials (ECs, machines, sink)
     init_flows/init_fb: warm-start assignment (zeros for a cold solve); the
       phase refinement step keeps whatever part of it is still eps-optimal
@@ -358,9 +360,12 @@ def _solve_device(costs, supply, capacity, unsched_cost, init_prices,
     supply = supply.astype(jnp.int32)
     cap = capacity.astype(jnp.int32)
     total = jnp.sum(supply)
-    # Arc capacity min(s_e, c_m): never binds an optimal solution but keeps
-    # saturation-induced deficits small.
-    Uem = jnp.minimum(supply[:, None], cap[None, :])
+    # Arc capacity min(s_e, c_m, fit): the supply/column clamp never binds
+    # an optimal solution but keeps saturation-induced deficits small; the
+    # fit bound is a real constraint from the cost model.
+    Uem = jnp.minimum(
+        jnp.minimum(supply[:, None], cap[None, :]), arc_cap.astype(jnp.int32)
+    )
 
     pe = init_prices[:E]
     pm = init_prices[E:E + M]
@@ -394,6 +399,7 @@ def solve_transport(
     unsched_cost: np.ndarray,
     init_prices: Optional[np.ndarray] = None,
     *,
+    arc_capacity: Optional[np.ndarray] = None,
     init_flows: Optional[np.ndarray] = None,
     init_unsched: Optional[np.ndarray] = None,
     eps_start: Optional[int] = None,
@@ -463,10 +469,17 @@ def solve_transport(
         init_flows = np.zeros((E, M), dtype=np.int32)
     if init_unsched is None:
         init_unsched = np.zeros(E, dtype=np.int32)
+    if arc_capacity is None:
+        arc_capacity = np.full((E, M), _POS, dtype=np.int32)
+    else:
+        arc_capacity = np.asarray(arc_capacity, dtype=np.int32)
+        if (arc_capacity < 0).any():
+            raise ValueError("arc_capacity must be non-negative")
 
     flows, unsched, prices, iters = _solve_device(
         jnp.asarray(costs), jnp.asarray(supply), jnp.asarray(capacity),
-        jnp.asarray(unsched_cost), jnp.asarray(init_prices, dtype=jnp.int32),
+        jnp.asarray(unsched_cost), jnp.asarray(arc_capacity),
+        jnp.asarray(init_prices, dtype=jnp.int32),
         jnp.asarray(init_flows, dtype=jnp.int32),
         jnp.asarray(init_unsched, dtype=jnp.int32),
         jnp.asarray(eps_sched),

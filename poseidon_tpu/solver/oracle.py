@@ -21,12 +21,13 @@ def transport_objective(
     supply: np.ndarray,
     capacity: np.ndarray,
     unsched_cost: np.ndarray,
+    arc_capacity: np.ndarray | None = None,
 ) -> int:
     """Exact optimal objective of the EC->machine transportation instance.
 
-    Graph: source -> EC (cap s_e) -> machine (cost C[e,m]) -> sink
-    (cap c_m), plus EC -> sink fallback arcs at the unscheduled cost.
-    Always feasible because of the fallback.
+    Graph: source -> EC (cap s_e) -> machine (cost C[e,m], cap
+    arc_capacity[e,m] if given) -> sink (cap c_m), plus EC -> sink fallback
+    arcs at the unscheduled cost.  Always feasible because of the fallback.
     """
     costs = np.asarray(costs)
     supply = np.asarray(supply)
@@ -48,7 +49,10 @@ def transport_objective(
             c = int(costs[e, m])
             if c >= INF_COST or capacity[m] <= 0:
                 continue
-            g.add_edge(("ec", e), ("mach", m), capacity=s, weight=c)
+            acap = s if arc_capacity is None else min(s, int(arc_capacity[e, m]))
+            if acap <= 0:
+                continue
+            g.add_edge(("ec", e), ("mach", m), capacity=acap, weight=c)
     for m in range(M):
         if capacity[m] > 0:
             g.add_edge(("mach", m), "sink", capacity=int(capacity[m]), weight=0)

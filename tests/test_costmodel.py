@@ -1,0 +1,138 @@
+"""Cost-model tests: admissibility gating, fit bounds, load pricing.
+
+Modeled on the reference's table-driven parsing/conversion unit tests
+(reference pkg/k8sclient/nodewatcher_test.go:120-216 style).
+"""
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.costmodel import (
+    CpuMemCostModel,
+    TrivialCostModel,
+    get_cost_model,
+    selector_admissibility,
+)
+from poseidon_tpu.costmodel.base import ECTable, MachineTable, NORMALIZED_COST
+from poseidon_tpu.costmodel.selectors import (
+    EXISTS_KEY,
+    IN_SET,
+    NOT_EXISTS_KEY,
+    NOT_IN_SET,
+)
+from poseidon_tpu.ops.transport import INF_COST
+
+
+def make_ecs(requests, selectors=None, waits=None):
+    n = len(requests)
+    return ECTable(
+        ec_ids=np.arange(n, dtype=np.uint64),
+        cpu_request=np.array([r[0] for r in requests], dtype=np.int64),
+        ram_request=np.array([r[1] for r in requests], dtype=np.int64),
+        supply=np.ones(n, dtype=np.int32),
+        priority=np.zeros(n, dtype=np.int32),
+        task_type=np.zeros(n, dtype=np.int32),
+        max_wait_rounds=np.array(waits or [0] * n, dtype=np.int32),
+        selectors=selectors or [() for _ in range(n)],
+    )
+
+
+def make_machines(caps, labels=None, slots=10):
+    m = len(caps)
+    return MachineTable(
+        uuids=[f"m{i}" for i in range(m)],
+        cpu_capacity=np.array([c[0] for c in caps], dtype=np.int64),
+        ram_capacity=np.array([c[1] for c in caps], dtype=np.int64),
+        cpu_used=np.zeros(m, dtype=np.int64),
+        ram_used=np.zeros(m, dtype=np.int64),
+        cpu_util=np.zeros(m, dtype=np.float32),
+        mem_util=np.zeros(m, dtype=np.float32),
+        slots_free=np.full(m, slots, dtype=np.int32),
+        labels=labels or [{} for _ in range(m)],
+    )
+
+
+class TestSelectorAdmissibility:
+    def test_empty_selectors_admit_all(self):
+        mask = selector_admissibility([()], [{}, {"a": "b"}])
+        assert mask.all()
+
+    def test_in_set(self):
+        sels = [((IN_SET, "zone", ("us-1", "us-2")),)]
+        labels = [{"zone": "us-1"}, {"zone": "eu-1"}, {}]
+        mask = selector_admissibility(sels, labels)
+        assert mask.tolist() == [[True, False, False]]
+
+    def test_not_in_set(self):
+        sels = [((NOT_IN_SET, "zone", ("us-1",)),)]
+        labels = [{"zone": "us-1"}, {"zone": "eu-1"}, {}]
+        mask = selector_admissibility(sels, labels)
+        assert mask.tolist() == [[False, True, True]]
+
+    def test_exists_and_not_exists(self):
+        sels = [
+            ((EXISTS_KEY, "gpu", ()),),
+            ((NOT_EXISTS_KEY, "gpu", ()),),
+        ]
+        labels = [{"gpu": "yes"}, {}]
+        mask = selector_admissibility(sels, labels)
+        assert mask.tolist() == [[True, False], [False, True]]
+
+    def test_conjunction(self):
+        sels = [((IN_SET, "zone", ("z1",)), (EXISTS_KEY, "ssd", ()))]
+        labels = [{"zone": "z1", "ssd": "1"}, {"zone": "z1"}, {"ssd": "1"}]
+        mask = selector_admissibility(sels, labels)
+        assert mask.tolist() == [[True, False, False]]
+
+
+class TestCpuMemModel:
+    def test_no_fit_is_inadmissible(self):
+        ecs = make_ecs([(2000, 1000)])
+        mt = make_machines([(1000, 4_000_000), (4000, 4_000_000)])
+        cm = CpuMemCostModel().build(ecs, mt)
+        assert cm.costs[0, 0] == INF_COST
+        assert cm.costs[0, 1] < INF_COST
+        assert cm.arc_capacity[0, 0] == 0
+        assert cm.arc_capacity[0, 1] == 2  # 4000/2000 cpu-bound
+
+    def test_less_loaded_machine_cheaper(self):
+        ecs = make_ecs([(500, 100_000)])
+        mt = make_machines([(1000, 1_000_000), (8000, 8_000_000)])
+        cm = CpuMemCostModel().build(ecs, mt)
+        assert cm.costs[0, 1] < cm.costs[0, 0]
+
+    def test_measured_utilization_raises_cost(self):
+        ecs = make_ecs([(100, 1000)])
+        mt = make_machines([(4000, 4_000_000), (4000, 4_000_000)])
+        mt.cpu_util = np.array([0.9, 0.0], dtype=np.float32)
+        mt.mem_util = np.array([0.9, 0.0], dtype=np.float32)
+        cm = CpuMemCostModel().build(ecs, mt)
+        assert cm.costs[0, 0] > cm.costs[0, 1]
+
+    def test_wait_rounds_escalate_unscheduled_cost(self):
+        ecs = make_ecs([(1, 1), (1, 1)], waits=[0, 5])
+        mt = make_machines([(1000, 1_000_000)])
+        cm = CpuMemCostModel().build(ecs, mt)
+        assert cm.unsched_cost[1] > cm.unsched_cost[0]
+
+    def test_selector_gates_arcs(self):
+        ecs = make_ecs(
+            [(1, 1)], selectors=[((IN_SET, "zone", ("z9",)),)]
+        )
+        mt = make_machines([(1000, 1_000_000)], labels=[{"zone": "z1"}])
+        cm = CpuMemCostModel().build(ecs, mt)
+        assert cm.costs[0, 0] == INF_COST
+
+    def test_empty_tables(self):
+        cm = CpuMemCostModel().build(make_ecs([]), make_machines([]))
+        assert cm.costs.shape == (0, 0)
+
+
+class TestRegistry:
+    def test_lookup(self):
+        assert isinstance(get_cost_model("cpu_mem"), CpuMemCostModel)
+        assert isinstance(get_cost_model("trivial"), TrivialCostModel)
+
+    def test_unknown(self):
+        with pytest.raises(ValueError):
+            get_cost_model("nope")

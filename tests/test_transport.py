@@ -165,6 +165,33 @@ def test_warm_incremental_resolve_parity(seed):
     assert sol2.objective == expected, (seed, sol2.objective, expected)
 
 
+@pytest.mark.parametrize("seed", range(8))
+def test_parity_with_arc_capacity(seed):
+    """Per-arc fit bounds (the cpu_mem multi-dimensional packing limit)
+    must thread through the jitted Uem clamp and still match the oracle."""
+    rng = np.random.default_rng(300 + seed)
+    E = int(rng.integers(2, 8))
+    M = int(rng.integers(2, 10))
+    costs, supply, cap, unsched = random_instance(rng, E, M)
+    arc_cap = rng.integers(0, 4, size=(E, M)).astype(np.int32)
+    sol = solve_transport(costs, supply, cap, unsched, arc_capacity=arc_cap)
+    check_solution_feasible(sol, costs, supply, cap)
+    assert (sol.flows <= arc_cap).all()
+    expected = oracle.transport_objective(
+        costs, supply, cap, unsched, arc_capacity=arc_cap
+    )
+    assert sol.objective == expected, (seed, sol.objective, expected)
+
+
+def test_negative_arc_capacity_rejected():
+    with pytest.raises(ValueError):
+        solve_transport(
+            np.zeros((1, 1), np.int32), np.ones(1, np.int32),
+            np.ones(1, np.int32), np.ones(1, np.int32),
+            arc_capacity=np.array([[-1]], np.int32),
+        )
+
+
 def test_empty_instances():
     sol = solve_transport(
         np.zeros((0, 3), np.int32), np.zeros(0, np.int32),
