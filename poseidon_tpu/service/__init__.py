@@ -1,0 +1,17 @@
+"""firmament-tpu: the scheduler service half of the framework.
+
+The gRPC surface (13 RPCs, reference pkg/firmament/firmament_scheduler.proto:15-45)
+fronts the TPU solve path: graph mutations accumulate in ClusterState, and
+``Schedule()`` runs one RoundPlanner round (EC collapse -> cost model ->
+jit-compiled min-cost max-flow -> SchedulingDeltas).
+"""
+
+from poseidon_tpu.service.server import FirmamentTPUServer, FirmamentServicer
+from poseidon_tpu.service.client import FirmamentClient, FatalReplyError
+
+__all__ = [
+    "FirmamentTPUServer",
+    "FirmamentServicer",
+    "FirmamentClient",
+    "FatalReplyError",
+]

@@ -1,0 +1,158 @@
+"""Typed client wrapper for the FirmamentScheduler service.
+
+Mirrors the reference's Go wrapper semantics (pkg/firmament/firmament_client.go:29-221):
+one method per RPC, and *fatal* treatment of reply enums the client never
+expects in a healthy system (NOT_FOUND on lifecycle RPCs, etc.) — here a
+raised ``FatalReplyError`` instead of ``glog.Fatalf`` so callers decide
+whether to die (the glue process does, matching the reference's posture).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import grpc
+
+from poseidon_tpu.protos import firmament_pb2 as fpb
+from poseidon_tpu.protos.services import (
+    FIRMAMENT_METHODS,
+    FIRMAMENT_SERVICE,
+    make_stubs,
+)
+
+
+class FatalReplyError(RuntimeError):
+    """A reply enum the reference client treats as fatal (firmament_client.go:44-50)."""
+
+    def __init__(self, rpc: str, reply: int) -> None:
+        super().__init__(f"{rpc}: fatal reply {reply}")
+        self.rpc = rpc
+        self.reply = reply
+
+
+# Acceptable replies per RPC; anything else is fatal.  TASK_ALREADY_SUBMITTED
+# and NODE_ALREADY_EXISTS are tolerated on submit/add because a restarted
+# Poseidon re-plays the world from list+watch (SURVEY.md section 5,
+# firmament_scheduler.proto:118,128).
+_OK = {
+    "TaskSubmitted": {fpb.TASK_SUBMITTED_OK, fpb.TASK_ALREADY_SUBMITTED},
+    "TaskCompleted": {fpb.TASK_COMPLETED_OK},
+    "TaskFailed": {fpb.TASK_FAILED_OK},
+    "TaskRemoved": {fpb.TASK_REMOVED_OK},
+    "TaskUpdated": {fpb.TASK_UPDATED_OK},
+    "NodeAdded": {fpb.NODE_ADDED_OK, fpb.NODE_ALREADY_EXISTS},
+    "NodeFailed": {fpb.NODE_FAILED_OK},
+    "NodeRemoved": {fpb.NODE_REMOVED_OK},
+    "NodeUpdated": {fpb.NODE_UPDATED_OK},
+    "AddTaskStats": None,  # stats for unknown entities are dropped, not fatal
+    "AddNodeStats": None,
+}
+
+
+class FirmamentClient:
+    """Insecure-channel client, one typed method per RPC."""
+
+    def __init__(self, address: str) -> None:
+        self._channel = grpc.insecure_channel(address)
+        self._stubs = make_stubs(
+            self._channel, FIRMAMENT_SERVICE, FIRMAMENT_METHODS
+        )
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def __enter__(self) -> "FirmamentClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _check(self, rpc: str, reply: int) -> int:
+        ok = _OK[rpc]
+        if ok is not None and reply not in ok:
+            raise FatalReplyError(rpc, reply)
+        return reply
+
+    # ------------------------------------------------------------------ RPCs
+
+    def schedule(self) -> List[fpb.SchedulingDelta]:
+        return list(self._stubs.Schedule(fpb.ScheduleRequest()).deltas)
+
+    def task_submitted(
+        self, td: fpb.TaskDescriptor, jd: Optional[fpb.JobDescriptor] = None
+    ) -> int:
+        req = fpb.TaskDescription(task_descriptor=td)
+        if jd is not None:
+            req.job_descriptor.CopyFrom(jd)
+        return self._check(
+            "TaskSubmitted", self._stubs.TaskSubmitted(req).type
+        )
+
+    def task_completed(self, uid: int) -> int:
+        return self._check(
+            "TaskCompleted",
+            self._stubs.TaskCompleted(fpb.TaskUID(task_uid=uid)).type,
+        )
+
+    def task_failed(self, uid: int) -> int:
+        return self._check(
+            "TaskFailed", self._stubs.TaskFailed(fpb.TaskUID(task_uid=uid)).type
+        )
+
+    def task_removed(self, uid: int) -> int:
+        return self._check(
+            "TaskRemoved",
+            self._stubs.TaskRemoved(fpb.TaskUID(task_uid=uid)).type,
+        )
+
+    def task_updated(
+        self, td: fpb.TaskDescriptor, jd: Optional[fpb.JobDescriptor] = None
+    ) -> int:
+        req = fpb.TaskDescription(task_descriptor=td)
+        if jd is not None:
+            req.job_descriptor.CopyFrom(jd)
+        return self._check("TaskUpdated", self._stubs.TaskUpdated(req).type)
+
+    def node_added(self, rtnd: fpb.ResourceTopologyNodeDescriptor) -> int:
+        return self._check("NodeAdded", self._stubs.NodeAdded(rtnd).type)
+
+    def node_failed(self, uuid: str) -> int:
+        return self._check(
+            "NodeFailed",
+            self._stubs.NodeFailed(fpb.ResourceUID(resource_uid=uuid)).type,
+        )
+
+    def node_removed(self, uuid: str) -> int:
+        return self._check(
+            "NodeRemoved",
+            self._stubs.NodeRemoved(fpb.ResourceUID(resource_uid=uuid)).type,
+        )
+
+    def node_updated(self, rtnd: fpb.ResourceTopologyNodeDescriptor) -> int:
+        return self._check("NodeUpdated", self._stubs.NodeUpdated(rtnd).type)
+
+    def add_task_stats(self, stats: fpb.TaskStats) -> int:
+        return self._stubs.AddTaskStats(stats).type
+
+    def add_node_stats(self, stats: fpb.ResourceStats) -> int:
+        return self._stubs.AddNodeStats(stats).type
+
+    def check(self) -> int:
+        return self._stubs.Check(fpb.HealthCheckRequest()).status
+
+    # -------------------------------------------------------------- start gate
+
+    def wait_for_service(
+        self, timeout: float = 600.0, poll_interval: float = 2.0
+    ) -> bool:
+        """Poll Check() until SERVING (poseidon.go:75-88: 2s x <=10min)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                if self.check() == fpb.SERVING:
+                    return True
+            except grpc.RpcError:
+                pass
+            time.sleep(poll_interval)
+        return False

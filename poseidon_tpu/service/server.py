@@ -1,0 +1,215 @@
+"""The firmament-tpu gRPC server: all 13 FirmamentScheduler RPCs.
+
+Replaces the external C++ Firmament process the reference drives
+(reference deploy/firmament-deployment.yaml:29-31); the wire contract is
+identical (firmament_scheduler.proto:15-45), the solve path underneath is
+the TPU RoundPlanner.
+
+Reply-enum fidelity is load-bearing: the Poseidon client ``glog.Fatalf``s
+on unexpected answers (firmament_client.go:44-50 et al.), so all state
+machine answers come straight from graph/state.py which mirrors
+Firmament's.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from poseidon_tpu.costmodel import get_cost_model
+from poseidon_tpu.graph.instance import RoundPlanner
+from poseidon_tpu.graph.state import ClusterState
+from poseidon_tpu.protos import firmament_pb2 as fpb
+from poseidon_tpu.protos.services import (
+    FIRMAMENT_METHODS,
+    FIRMAMENT_SERVICE,
+    generic_handler,
+)
+from poseidon_tpu.service import converters
+from poseidon_tpu.utils.config import FirmamentTPUConfig, load_config
+
+log = logging.getLogger("firmament_tpu")
+
+
+class FirmamentServicer:
+    """Method-per-RPC servicer bound via the generic handler table."""
+
+    def __init__(
+        self,
+        state: Optional[ClusterState] = None,
+        planner: Optional[RoundPlanner] = None,
+        config: Optional[FirmamentTPUConfig] = None,
+    ) -> None:
+        self.config = config or FirmamentTPUConfig()
+        self.state = state or ClusterState()
+        self.planner = planner or RoundPlanner(
+            self.state, get_cost_model(self.config.cost_model)
+        )
+        # Schedule() rounds are serialized: the planner's warm-start state
+        # is single-writer (the reference client also calls Schedule from
+        # one loop, cmd/poseidon/poseidon.go:32-72).
+        self._schedule_lock = threading.Lock()
+
+    # ------------------------------------------------------------- scheduling
+
+    def Schedule(self, request, context):
+        with self._schedule_lock:
+            deltas, metrics = self.planner.schedule_round()
+        log.info(
+            "round %d: %d tasks / %d ECs / %d machines -> "
+            "%d place %d preempt %d migrate %d unsched; "
+            "solve %.3fs total %.3fs objective %d",
+            metrics.round_index, metrics.num_tasks, metrics.num_ecs,
+            metrics.num_machines, metrics.placed, metrics.preempted,
+            metrics.migrated, metrics.unscheduled, metrics.solve_seconds,
+            metrics.total_seconds, metrics.objective,
+        )
+        return converters.deltas_to_proto(deltas)
+
+    # ----------------------------------------------------------- task lifecycle
+
+    def TaskSubmitted(self, request, context):
+        job_id = request.job_descriptor.uuid
+        task = converters.task_info_from_proto(
+            request.task_descriptor, job_id=job_id
+        )
+        reply = self.state.task_submitted(task)
+        return fpb.TaskSubmittedResponse(type=int(reply))
+
+    def TaskCompleted(self, request, context):
+        reply = self.state.task_completed(int(request.task_uid))
+        return fpb.TaskCompletedResponse(type=int(reply))
+
+    def TaskFailed(self, request, context):
+        reply = self.state.task_failed(int(request.task_uid))
+        return fpb.TaskFailedResponse(type=int(reply))
+
+    def TaskRemoved(self, request, context):
+        reply = self.state.task_removed(int(request.task_uid))
+        return fpb.TaskRemovedResponse(type=int(reply))
+
+    def TaskUpdated(self, request, context):
+        task = converters.task_info_from_proto(
+            request.task_descriptor, job_id=request.job_descriptor.uuid
+        )
+        reply = self.state.task_updated(task)
+        return fpb.TaskUpdatedResponse(type=int(reply))
+
+    # ----------------------------------------------------------- node lifecycle
+
+    def NodeAdded(self, request, context):
+        machine = converters.machine_info_from_proto(request)
+        reply = self.state.node_added(machine)
+        return fpb.NodeAddedResponse(type=int(reply))
+
+    def NodeFailed(self, request, context):
+        reply = self.state.node_failed(request.resource_uid)
+        return fpb.NodeFailedResponse(type=int(reply))
+
+    def NodeRemoved(self, request, context):
+        reply = self.state.node_removed(request.resource_uid)
+        return fpb.NodeRemovedResponse(type=int(reply))
+
+    def NodeUpdated(self, request, context):
+        machine = converters.machine_info_from_proto(request)
+        reply = self.state.node_updated(machine)
+        return fpb.NodeUpdatedResponse(type=int(reply))
+
+    # ------------------------------------------------------------------- stats
+
+    def AddTaskStats(self, request, context):
+        reply = self.state.add_task_stats(
+            int(request.task_id), converters.task_stats_sample(request)
+        )
+        return fpb.TaskStatsResponse(type=int(reply))
+
+    def AddNodeStats(self, request, context):
+        reply = self.state.add_node_stats(
+            request.resource_id, converters.resource_stats_sample(request)
+        )
+        return fpb.ResourceStatsResponse(type=int(reply))
+
+    # ------------------------------------------------------------------ health
+
+    def Check(self, request, context):
+        # The startup gate polls this until SERVING (poseidon.go:75-88).
+        return fpb.HealthCheckResponse(status=fpb.SERVING)
+
+
+class FirmamentTPUServer:
+    """Owns the grpc.Server; usable as a context manager in tests."""
+
+    def __init__(
+        self,
+        config: Optional[FirmamentTPUConfig] = None,
+        address: Optional[str] = None,
+        max_workers: int = 16,
+    ) -> None:
+        self.config = config or FirmamentTPUConfig()
+        if address is not None:
+            self.config.listen_address = address
+        self.servicer = FirmamentServicer(config=self.config)
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers)
+        )
+        self._server.add_generic_rpc_handlers(
+            (
+                generic_handler(
+                    FIRMAMENT_SERVICE, FIRMAMENT_METHODS, self.servicer
+                ),
+            )
+        )
+        self.port = self._server.add_insecure_port(self.config.listen_address)
+        if self.port == 0:
+            raise RuntimeError(
+                f"could not bind {self.config.listen_address}"
+            )
+
+    @property
+    def address(self) -> str:
+        host = self.config.listen_address.rsplit(":", 1)[0]
+        if host in ("0.0.0.0", "[::]", ""):
+            host = "127.0.0.1"
+        return f"{host}:{self.port}"
+
+    def start(self) -> "FirmamentTPUServer":
+        self._server.start()
+        log.info("firmament-tpu serving on %s", self.address)
+        return self
+
+    def stop(self, grace: Optional[float] = None) -> None:
+        self._server.stop(grace).wait()
+
+    def wait(self) -> None:
+        self._server.wait_for_termination()
+
+    def __enter__(self) -> "FirmamentTPUServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop(grace=0.5)
+
+
+def main(argv=None) -> None:
+    """Process entry point (the analog of the firmament_scheduler binary)."""
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s] %(message)s",
+    )
+    cfg = load_config(FirmamentTPUConfig, argv=argv)
+    server = FirmamentTPUServer(config=cfg).start()
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    server.stop(grace=2.0)
+
+
+if __name__ == "__main__":
+    main()

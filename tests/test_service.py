@@ -1,0 +1,197 @@
+"""End-to-end tests of the firmament-tpu gRPC service.
+
+The reference's integration tier drives a real Firmament deployment through
+the 13-RPC surface (test/e2e/poseidon_integration.go); here the service runs
+in-process on a loopback port and a FirmamentClient (the typed wrapper with
+the reference's fatal-reply semantics) plays the Poseidon role.
+"""
+
+import pytest
+
+from poseidon_tpu.protos import firmament_pb2 as fpb
+from poseidon_tpu.service import (
+    FatalReplyError,
+    FirmamentClient,
+    FirmamentTPUServer,
+)
+from poseidon_tpu.utils.config import FirmamentTPUConfig
+from poseidon_tpu.utils.ids import generate_uuid, hash_combine
+
+
+def make_task(uid, job="job-1", cpu=100, ram=1 << 20, selectors=(), prio=0):
+    td = fpb.TaskDescriptor(uid=uid, name=f"task-{uid}", job_id=job)
+    td.resource_request.cpu_cores = cpu
+    td.resource_request.ram_cap = ram
+    td.priority = prio
+    for stype, key, values in selectors:
+        td.label_selectors.add(type=stype, key=key, values=list(values))
+    jd = fpb.JobDescriptor(uuid=job, name=job)
+    return td, jd
+
+
+def make_node(uuid, cpu=4000, ram=16 << 20, labels=None, slots=100):
+    rtnd = fpb.ResourceTopologyNodeDescriptor()
+    rd = rtnd.resource_desc
+    rd.uuid = uuid
+    rd.friendly_name = f"node-{uuid[:8]}"
+    rd.type = fpb.ResourceDescriptor.RESOURCE_MACHINE
+    rd.resource_capacity.cpu_cores = cpu
+    rd.resource_capacity.ram_cap = ram
+    rd.task_capacity = slots
+    for k, v in (labels or {}).items():
+        rd.labels.add(key=k, value=v)
+    pu = rtnd.children.add()
+    pu.resource_desc.uuid = uuid + "-pu0"
+    pu.resource_desc.type = fpb.ResourceDescriptor.RESOURCE_PU
+    pu.parent_id = uuid
+    return rtnd
+
+
+@pytest.fixture(scope="module")
+def server():
+    with FirmamentTPUServer(address="127.0.0.1:0") as srv:
+        yield srv
+
+
+@pytest.fixture()
+def client(server):
+    # Fresh state per test: servicer state is reset by rebuilding it.
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+    from poseidon_tpu.graph.state import ClusterState
+
+    sv = server.servicer
+    sv.state = ClusterState()
+    sv.planner = RoundPlanner(sv.state, get_cost_model(sv.config.cost_model))
+    with FirmamentClient(server.address) as c:
+        yield c
+
+
+def test_health_gate(client):
+    assert client.check() == fpb.SERVING
+    assert client.wait_for_service(timeout=5.0, poll_interval=0.1)
+
+
+def test_place_all_tasks_one_round(client):
+    n1, n2 = generate_uuid("n1"), generate_uuid("n2")
+    assert client.node_added(make_node(n1)) == fpb.NODE_ADDED_OK
+    assert client.node_added(make_node(n2)) == fpb.NODE_ADDED_OK
+    for i in range(6):
+        td, jd = make_task(hash_combine(1, i))
+        assert client.task_submitted(td, jd) == fpb.TASK_SUBMITTED_OK
+
+    deltas = client.schedule()
+    assert len(deltas) == 6
+    assert all(d.type == fpb.SchedulingDelta.PLACE for d in deltas)
+    assert {d.resource_id for d in deltas} <= {n1, n2}
+    # Second round with no changes: no deltas (NOOPs are elided,
+    # cmd/poseidon/poseidon.go:64).
+    assert client.schedule() == []
+
+
+def test_task_lifecycle_reply_enums(client):
+    td, jd = make_task(42)
+    assert client.task_submitted(td, jd) == fpb.TASK_SUBMITTED_OK
+    # Re-submission of a runnable task is tolerated (restart re-play).
+    assert client.task_submitted(td, jd) == fpb.TASK_ALREADY_SUBMITTED
+    assert client.task_completed(42) == fpb.TASK_COMPLETED_OK
+    assert client.task_removed(42) == fpb.TASK_REMOVED_OK
+    # Unknown uids are fatal to the reference client.
+    with pytest.raises(FatalReplyError):
+        client.task_completed(42)
+    with pytest.raises(FatalReplyError):
+        client.task_failed(99)
+    with pytest.raises(FatalReplyError):
+        client.task_removed(99)
+
+
+def test_node_lifecycle_reply_enums(client):
+    uuid = generate_uuid("node-a")
+    rtnd = make_node(uuid)
+    assert client.node_added(rtnd) == fpb.NODE_ADDED_OK
+    assert client.node_added(rtnd) == fpb.NODE_ALREADY_EXISTS
+    assert client.node_updated(rtnd) == fpb.NODE_UPDATED_OK
+    # Failure/removal addressed by a PU uuid resolves to the machine.
+    assert client.node_failed(uuid + "-pu0") == fpb.NODE_FAILED_OK
+    assert client.node_removed(uuid) == fpb.NODE_REMOVED_OK
+    with pytest.raises(FatalReplyError):
+        client.node_removed(uuid)
+    with pytest.raises(FatalReplyError):
+        client.node_updated(rtnd)
+
+
+def test_failed_node_evicts_and_replaces(client):
+    n1, n2 = generate_uuid("nf1"), generate_uuid("nf2")
+    client.node_added(make_node(n1))
+    td, jd = make_task(7)
+    client.task_submitted(td, jd)
+    (delta,) = client.schedule()
+    assert delta.resource_id == n1
+
+    client.node_added(make_node(n2))
+    assert client.node_failed(n1) == fpb.NODE_FAILED_OK
+    (delta2,) = client.schedule()
+    # Task went back to runnable and is re-placed on the healthy node.
+    assert delta2.type == fpb.SchedulingDelta.PLACE
+    assert delta2.resource_id == n2
+
+
+def test_selector_gating_over_wire(client):
+    labeled = generate_uuid("lab")
+    plain = generate_uuid("plain")
+    client.node_added(make_node(labeled, labels={"disktype": "ssd"}))
+    client.node_added(make_node(plain))
+    td, jd = make_task(
+        11, selectors=[(fpb.LabelSelector.IN_SET, "disktype", ("ssd",))]
+    )
+    client.task_submitted(td, jd)
+    (delta,) = client.schedule()
+    assert delta.resource_id == labeled
+
+
+def test_oversized_task_stays_unscheduled(client):
+    n = generate_uuid("small")
+    client.node_added(make_node(n, cpu=1000, ram=1 << 20))
+    td, jd = make_task(13, cpu=8000, ram=1 << 22)
+    client.task_submitted(td, jd)
+    assert client.schedule() == []  # no PLACE: nothing fits
+
+
+def test_stats_ingestion(client):
+    n = generate_uuid("stats-node")
+    client.node_added(make_node(n))
+    td, jd = make_task(21)
+    client.task_submitted(td, jd)
+
+    rs = fpb.ResourceStats(resource_id=n + "-pu0", mem_utilization=0.5)
+    rs.cpus_stats.add(cpu_utilization=0.25)
+    rs.cpus_stats.add(cpu_utilization=0.75)
+    assert client.add_node_stats(rs) == fpb.NODE_ADDED_OK
+
+    ts = fpb.TaskStats(task_id=21, cpu_usage=50, mem_usage=1024)
+    assert client.add_task_stats(ts) == fpb.TASK_SUBMITTED_OK
+
+    # Unknown entities: NOT_FOUND, dropped without raising (stats.go:89-91).
+    assert (
+        client.add_node_stats(fpb.ResourceStats(resource_id="nope"))
+        == fpb.NODE_NOT_FOUND
+    )
+    assert (
+        client.add_task_stats(fpb.TaskStats(task_id=999))
+        == fpb.TASK_NOT_FOUND
+    )
+
+
+def test_utilization_steers_placement(client):
+    """AddNodeStats -> knowledge base -> cost model -> placement choice."""
+    hot, cold = generate_uuid("hot"), generate_uuid("cold")
+    client.node_added(make_node(hot))
+    client.node_added(make_node(cold))
+    rs = fpb.ResourceStats(resource_id=hot, mem_utilization=0.95)
+    rs.cpus_stats.add(cpu_utilization=0.95)
+    for _ in range(4):  # push the EMA up
+        client.add_node_stats(rs)
+    td, jd = make_task(31)
+    client.task_submitted(td, jd)
+    (delta,) = client.schedule()
+    assert delta.resource_id == cold
