@@ -1,0 +1,102 @@
+"""Shared glue-process state: the id maps joining watchers, stats, and the
+schedule loop.
+
+Mirrors the reference's shared maps + RW mutexes (pkg/k8sclient/types.go:31-48):
+PodToTD / TaskIDToPod / NodeToRTND / ResIDToNode, here folded into one
+lock-guarded registry with typed accessors.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from poseidon_tpu.glue.fake_kube import Node, Pod
+from poseidon_tpu.protos import firmament_pb2 as fpb
+
+
+@dataclass
+class TaskEntry:
+    pod: Pod
+    descriptor: fpb.TaskDescriptor
+
+
+@dataclass
+class NodeEntry:
+    node: Node
+    rtnd: fpb.ResourceTopologyNodeDescriptor
+
+
+class SharedState:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._tasks: Dict[int, TaskEntry] = {}          # task uid -> entry
+        self._pod_to_uid: Dict[str, int] = {}           # pod key -> task uid
+        self._nodes: Dict[str, NodeEntry] = {}          # node name -> entry
+        self._res_to_node: Dict[str, str] = {}          # resource uuid -> name
+
+    # ------------------------------------------------------------------ tasks
+
+    def put_task(self, uid: int, pod: Pod, td: fpb.TaskDescriptor) -> None:
+        with self._lock:
+            self._tasks[uid] = TaskEntry(pod=pod, descriptor=td)
+            self._pod_to_uid[pod.key] = uid
+
+    def get_task(self, uid: int) -> Optional[TaskEntry]:
+        with self._lock:
+            return self._tasks.get(uid)
+
+    def pop_task(self, uid: int) -> Optional[TaskEntry]:
+        with self._lock:
+            entry = self._tasks.pop(uid, None)
+            if entry is not None:
+                self._pod_to_uid.pop(entry.pod.key, None)
+            return entry
+
+    def uid_for_pod(self, pod_key: str) -> Optional[int]:
+        with self._lock:
+            return self._pod_to_uid.get(pod_key)
+
+    def task_for_uid(self, uid: int) -> Optional[Pod]:
+        with self._lock:
+            entry = self._tasks.get(uid)
+            return entry.pod if entry else None
+
+    # ------------------------------------------------------------------ nodes
+
+    def put_node(
+        self, node: Node, rtnd: fpb.ResourceTopologyNodeDescriptor
+    ) -> None:
+        with self._lock:
+            self._nodes[node.name] = NodeEntry(node=node, rtnd=rtnd)
+            self._register_subtree(node.name, rtnd)
+
+    def _register_subtree(self, name, rtnd) -> None:
+        self._res_to_node[rtnd.resource_desc.uuid] = name
+        for child in rtnd.children:
+            self._register_subtree(name, child)
+
+    def get_node(self, name: str) -> Optional[NodeEntry]:
+        with self._lock:
+            return self._nodes.get(name)
+
+    def pop_node(self, name: str) -> Optional[NodeEntry]:
+        with self._lock:
+            entry = self._nodes.pop(name, None)
+            if entry is not None:
+                dead = [
+                    r for r, n in self._res_to_node.items() if n == name
+                ]
+                for r in dead:
+                    del self._res_to_node[r]
+            return entry
+
+    def node_for_resource(self, uuid: str) -> Optional[str]:
+        with self._lock:
+            return self._res_to_node.get(uuid)
+
+    def resource_for_node(self, name: str) -> Optional[str]:
+        with self._lock:
+            entry = self._nodes.get(name)
+            return entry.rtnd.resource_desc.uuid if entry else None

@@ -27,8 +27,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from poseidon_tpu.costmodel.base import CostModel, ECTable, MachineTable
-from poseidon_tpu.graph.state import ClusterState, TaskInfo, TaskState
+from poseidon_tpu.costmodel.base import CostModel
+from poseidon_tpu.graph.state import ClusterState
 from poseidon_tpu.ops.transport import solve_transport
 
 
@@ -94,45 +94,6 @@ class RoundPlanner:
         self._warm = _WarmState()
         self.last_metrics = RoundMetrics()
 
-    # ------------------------------------------------------------ table build
-
-    def _build_tables(
-        self, tasks: List[TaskInfo], machines
-    ) -> Tuple[ECTable, MachineTable, Dict[int, List[TaskInfo]]]:
-        by_ec: Dict[int, List[TaskInfo]] = {}
-        for t in tasks:
-            by_ec.setdefault(t.ec_id, []).append(t)
-        ec_ids = sorted(by_ec)
-        reps = [by_ec[e][0] for e in ec_ids]
-        ecs = ECTable(
-            ec_ids=np.array(ec_ids, dtype=np.uint64),
-            cpu_request=np.array([r.cpu_request for r in reps], dtype=np.int64),
-            ram_request=np.array([r.ram_request for r in reps], dtype=np.int64),
-            supply=np.array([len(by_ec[e]) for e in ec_ids], dtype=np.int32),
-            priority=np.array([r.priority for r in reps], dtype=np.int32),
-            task_type=np.array([r.task_type for r in reps], dtype=np.int32),
-            max_wait_rounds=np.array(
-                [max(t.wait_rounds for t in by_ec[e]) for e in ec_ids],
-                dtype=np.int32,
-            ),
-            selectors=[r.selectors for r in reps],
-        )
-        machines = sorted(machines, key=lambda m: m.uuid)
-        mt = MachineTable(
-            uuids=[m.uuid for m in machines],
-            cpu_capacity=np.array([m.cpu_capacity for m in machines], np.int64),
-            ram_capacity=np.array([m.ram_capacity for m in machines], np.int64),
-            # The full re-solve assigns every task fresh each round, so no
-            # resources are pre-committed outside the solve.
-            cpu_used=np.zeros(len(machines), dtype=np.int64),
-            ram_used=np.zeros(len(machines), dtype=np.int64),
-            cpu_util=np.array([m.cpu_util for m in machines], np.float32),
-            mem_util=np.array([m.mem_util for m in machines], np.float32),
-            slots_free=np.array([m.task_slots for m in machines], np.int32),
-            labels=[m.labels for m in machines],
-        )
-        return ecs, mt, by_ec
-
     # ------------------------------------------------------------- warm start
 
     def _remap_warm(
@@ -173,19 +134,19 @@ class RoundPlanner:
     def schedule_round(self) -> Tuple[List[Delta], RoundMetrics]:
         t0 = time.perf_counter()
         st = self.state
-        tasks, machines, _gen = st.snapshot()
+        view = st.build_round_view()
+        ecs, mt = view.ecs, view.machines
         metrics = RoundMetrics(
             round_index=st.round_index,
-            num_tasks=len(tasks),
-            num_machines=len(machines),
+            num_tasks=int(ecs.supply.sum()),
+            num_machines=mt.num_machines,
         )
-        if not tasks:
+        if ecs.num_ecs == 0:
             st.round_index += 1
             metrics.total_seconds = time.perf_counter() - t0
             self.last_metrics = metrics
             return [], metrics
 
-        ecs, mt, by_ec = self._build_tables(tasks, machines)
         metrics.num_ecs = ecs.num_ecs
         cm = self.cost_model.build(ecs, mt)
 
@@ -216,7 +177,7 @@ class RoundPlanner:
             unsched=sol.unsched,
         )
 
-        deltas = self._assign(sol.flows, ecs, mt, by_ec, metrics)
+        deltas = self._assign(sol.flows, view, metrics)
         st.round_index += 1
         metrics.total_seconds = time.perf_counter() - t0
         self.last_metrics = metrics
@@ -227,71 +188,93 @@ class RoundPlanner:
     def _assign(
         self,
         flows: np.ndarray,
-        ecs: ECTable,
-        mt: MachineTable,
-        by_ec: Dict[int, List[TaskInfo]],
+        view,
         metrics: RoundMetrics,
     ) -> List[Delta]:
-        """EC-level flows -> per-task placements, stability-first."""
+        """EC-level flows -> per-task placements, stability-first.
+
+        Vectorized per EC (numpy over the member arrays; Python touches
+        only *changed* tasks, which in steady state is the churn set, not
+        the whole cluster):
+
+        1. members keep their current machine while the solution still
+           routes flow there (placement stability minimizes MIGRATEs);
+        2. leftover flow goes to the remainder, longest-waiting first
+           (bounded unfairness), machine columns in ascending order;
+        3. diffs against the previous placement become the deltas.
+        """
         deltas: List[Delta] = []
         st = self.state
-        uuid_to_col = {u: j for j, u in enumerate(mt.uuids)}
+        mt = view.machines
+        M = mt.num_machines
+        uuids = mt.uuids
+        placements: List[Tuple[int, Optional[str]]] = []
 
-        for i, ec in enumerate(ecs.ec_ids.tolist()):
-            members = sorted(by_ec[ec], key=lambda t: t.uid)
-            want: Dict[int, int] = {
-                j: int(flows[i, j]) for j in range(len(mt.uuids)) if flows[i, j]
-            }
-            assigned: Dict[int, int] = {}  # uid -> column
-            pool: List[TaskInfo] = []
+        for i in range(view.ecs.num_ecs):
+            uids = view.member_uids[i]
+            cur = view.member_cur[i]
+            wait = view.member_wait[i]
+            want = flows[i].astype(np.int64)
+            n = uids.size
+            new_col = np.full(n, -1, dtype=np.int64)
 
-            # Pass 1: keep tasks where they already run if the solution
-            # still routes flow there.
-            for t in members:
-                col = uuid_to_col.get(t.scheduled_to) if t.scheduled_to else None
-                if col is not None and want.get(col, 0) > 0:
-                    assigned[t.uid] = col
-                    want[col] -= 1
-                else:
-                    pool.append(t)
+            # Pass 1 (stability): within each machine column, the first
+            # `min(#residents, flow)` members by uid order stay.
+            has_cur = cur >= 0
+            if has_cur.any():
+                res_idx = np.nonzero(has_cur)[0]
+                cols = cur[res_idx].astype(np.int64)
+                counts = np.bincount(cols, minlength=M)
+                keep_quota = np.minimum(counts, want)
+                order = np.argsort(cols, kind="stable")
+                sorted_cols = cols[order]
+                first_occ = np.searchsorted(sorted_cols, sorted_cols, "left")
+                rank = np.arange(sorted_cols.size) - first_occ
+                keep = rank < keep_quota[sorted_cols]
+                stays = res_idx[order[keep]]
+                new_col[stays] = cur[stays]
+                used = np.bincount(new_col[stays], minlength=M)
+                rem = want - used
+            else:
+                rem = want
 
-            # Pass 2: longest-waiting first among the remainder (bounded
-            # unfairness; ties broken by uid for determinism).
-            pool.sort(key=lambda t: (-t.wait_rounds, t.uid))
-            remaining: List[Tuple[int, int]] = [
-                (j, want[j]) for j in sorted(want) if want[j] > 0
-            ]
-            ri = 0
-            for t in pool:
-                while ri < len(remaining) and remaining[ri][1] == 0:
-                    ri += 1
-                if ri >= len(remaining):
-                    assigned[t.uid] = -1  # unscheduled
-                else:
-                    j, n = remaining[ri]
-                    assigned[t.uid] = j
-                    remaining[ri] = (j, n - 1)
+            # Pass 2: longest-waiting first; ties by uid (members are
+            # uid-sorted, so index order is uid order).
+            pool = np.nonzero(new_col < 0)[0]
+            if pool.size:
+                pool = pool[np.lexsort((pool, -wait[pool]))]
+                cols_exp = np.repeat(np.arange(M, dtype=np.int64), rem)
+                k = min(pool.size, cols_exp.size)
+                if k:
+                    new_col[pool[:k]] = cols_exp[:k]
 
-            for t in members:
-                col = assigned[t.uid]
-                new_uuid = mt.uuids[col] if col >= 0 else None
-                old_uuid = t.scheduled_to
-                if new_uuid == old_uuid:
-                    if new_uuid is None:
-                        metrics.unscheduled += 1
-                        st.apply_placement(t.uid, None)
-                    continue
-                if old_uuid is None:
-                    deltas.append(Delta(t.uid, new_uuid, DeltaType.PLACE))
+            # Pass 3: diff -> deltas; only changed tasks touch Python.
+            if not self.preemption:
+                # Preemption disabled: evicted-by-the-solver tasks stay put.
+                evicted = (new_col < 0) & (cur >= 0)
+                new_col[evicted] = cur[evicted]
+            changed = np.nonzero(new_col != cur)[0]
+            metrics.unscheduled += int(((new_col < 0) & (cur < 0)).sum())
+            for j in changed.tolist():
+                uid = int(uids[j])
+                nc = int(new_col[j])
+                oc = int(cur[j])
+                if oc < 0:
+                    deltas.append(Delta(uid, uuids[nc], DeltaType.PLACE))
                     metrics.placed += 1
-                elif new_uuid is None:
-                    if not self.preemption:
-                        # Preemption disabled: leave the task in place.
-                        continue
-                    deltas.append(Delta(t.uid, "", DeltaType.PREEMPT))
+                    placements.append((uid, uuids[nc]))
+                elif nc < 0:
+                    deltas.append(Delta(uid, "", DeltaType.PREEMPT))
                     metrics.preempted += 1
+                    placements.append((uid, None))
                 else:
-                    deltas.append(Delta(t.uid, new_uuid, DeltaType.MIGRATE))
+                    deltas.append(Delta(uid, uuids[nc], DeltaType.MIGRATE))
                     metrics.migrated += 1
-                st.apply_placement(t.uid, new_uuid)
+                    placements.append((uid, uuids[nc]))
+            # Unscheduled-and-still-unscheduled tasks age their wait
+            # counter (the starvation escalator input).
+            still = np.nonzero((new_col < 0) & (cur < 0))[0]
+            placements.extend((int(uids[j]), None) for j in still.tolist())
+
+        st.apply_placements(placements)
         return deltas

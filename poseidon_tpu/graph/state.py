@@ -20,7 +20,6 @@ of the reference's degenerate one-PU topology.
 
 from __future__ import annotations
 
-import copy
 import enum
 import threading
 from collections import deque
@@ -95,9 +94,15 @@ class TaskInfo:
     # Cluster-trace replay hooks (task_desc.proto:98-99).
     trace_job_id: int = 0
     trace_task_id: int = 0
+    # Cached EC signature.  Computed once at construction and refreshed on
+    # update (recomputing the FNV chain for 100k tasks every round costs
+    # ~1s of the <1s round budget).
+    ec_id: int = 0
 
-    @property
-    def ec_id(self) -> int:
+    def __post_init__(self) -> None:
+        self.ec_id = self.compute_ec_id()
+
+    def compute_ec_id(self) -> int:
         return ec_signature(
             self.cpu_request,
             self.ram_request,
@@ -127,6 +132,24 @@ class MachineInfo:
 @dataclass
 class _KBEntry:
     samples: deque = field(default_factory=lambda: deque(maxlen=_STATS_WINDOW))
+
+
+@dataclass
+class RoundView:
+    """One round's schedulable world in columnar form.
+
+    ``ecs``/``machines`` are the cost-model tables; ``member_*[i]`` are
+    per-EC arrays aligned with ``ecs`` row ``i``, each sorted by task uid:
+    uid (uint64), current machine column (int32, -1 = unscheduled), and
+    wait rounds (int32).
+    """
+
+    ecs: object
+    machines: object
+    member_uids: list
+    member_cur: list
+    member_wait: list
+    generation: int
 
 
 class ClusterState:
@@ -219,6 +242,7 @@ class ClusterState:
             existing.task_type = task.task_type
             existing.selectors = task.selectors
             existing.labels = task.labels
+            existing.ec_id = existing.compute_ec_id()
             self.generation += 1
             return TaskReply.UPDATED_OK
 
@@ -326,34 +350,126 @@ class ClusterState:
 
     def apply_placement(self, uid: int, machine_uuid: Optional[str]) -> None:
         """Record the outcome of a round for one task."""
-        with self._lock:
-            task = self.tasks.get(uid)
-            if task is None:
-                return
-            task.scheduled_to = machine_uuid
-            if machine_uuid is None:
-                task.state = TaskState.RUNNABLE
-                task.wait_rounds += 1
-            else:
-                task.state = TaskState.RUNNING
-                task.wait_rounds = 0
-            self.generation += 1
+        self.apply_placements([(uid, machine_uuid)])
 
-    def snapshot(self):
-        """Consistent copy of the schedulable world for one round.
+    def apply_placements(self, placements) -> None:
+        """Batch `apply_placement` under one lock acquisition.
 
-        Returns shallow copies of the task/machine records so concurrent
-        RPC threads mutating the live objects cannot tear the planner's
-        view mid-round (updates replace attribute references rather than
-        mutating nested structures, so shallow copies suffice).
+        ``placements``: iterable of (uid, machine_uuid_or_None).  The
+        initial wave places 100k tasks in one round; per-task locking
+        would dominate the round budget.
         """
         with self._lock:
-            runnable = [
-                copy.copy(t)
-                for t in self.tasks.values()
-                if t.state in (TaskState.RUNNABLE, TaskState.RUNNING)
-            ]
-            machines = [
-                copy.copy(m) for m in self.machines.values() if m.healthy
-            ]
-            return runnable, machines, self.generation
+            for uid, machine_uuid in placements:
+                task = self.tasks.get(uid)
+                if task is None:
+                    continue
+                task.scheduled_to = machine_uuid
+                if machine_uuid is None:
+                    task.state = TaskState.RUNNABLE
+                    task.wait_rounds += 1
+                else:
+                    task.state = TaskState.RUNNING
+                    task.wait_rounds = 0
+            self.generation += 1
+
+    def build_round_view(self):
+        """Columnar tables for one round, built in a single pass under the
+        lock (no per-task object copies: at 100k tasks the copy/per-object
+        property overhead of `snapshot()` costs ~1.5s of the <1s round
+        budget).
+
+        Returns a ``RoundView`` (defined in costmodel.base's vocabulary):
+        EC/machine structure-of-arrays tables plus per-EC member arrays
+        (uid, current machine column, wait rounds) that the planner's
+        vectorized assignment consumes.
+        """
+        import numpy as np
+
+        from poseidon_tpu.costmodel.base import ECTable, MachineTable
+
+        with self._lock:
+            machines = [m for m in self.machines.values() if m.healthy]
+            machines.sort(key=lambda m: m.uuid)
+            uuid_to_col = {m.uuid: j for j, m in enumerate(machines)}
+
+            groups: Dict[int, list] = {}
+            reps: Dict[int, TaskInfo] = {}
+            for t in self.tasks.values():
+                if t.state not in (TaskState.RUNNABLE, TaskState.RUNNING):
+                    continue
+                g = groups.get(t.ec_id)
+                if g is None:
+                    groups[t.ec_id] = g = []
+                    reps[t.ec_id] = t
+                cur = uuid_to_col.get(t.scheduled_to, -1) \
+                    if t.scheduled_to else -1
+                g.append((t.uid, cur, t.wait_rounds))
+
+            ec_ids = sorted(groups)
+            member_uids, member_cur, member_wait = [], [], []
+            supply = np.empty(len(ec_ids), dtype=np.int32)
+            max_wait = np.empty(len(ec_ids), dtype=np.int32)
+            for i, e in enumerate(ec_ids):
+                g = groups[e]
+                k = len(g)
+                uid_arr = np.fromiter(
+                    (x[0] for x in g), dtype=np.uint64, count=k
+                )
+                cur_arr = np.fromiter(
+                    (x[1] for x in g), dtype=np.int32, count=k
+                )
+                wait_arr = np.fromiter(
+                    (x[2] for x in g), dtype=np.int32, count=k
+                )
+                order = np.argsort(uid_arr, kind="stable")
+                member_uids.append(uid_arr[order])
+                member_cur.append(cur_arr[order])
+                member_wait.append(wait_arr[order])
+                supply[i] = k
+                max_wait[i] = wait_arr.max() if k else 0
+
+            rep_list = [reps[e] for e in ec_ids]
+            ecs = ECTable(
+                ec_ids=np.array(ec_ids, dtype=np.uint64),
+                cpu_request=np.array(
+                    [r.cpu_request for r in rep_list], dtype=np.int64
+                ),
+                ram_request=np.array(
+                    [r.ram_request for r in rep_list], dtype=np.int64
+                ),
+                supply=supply,
+                priority=np.array(
+                    [r.priority for r in rep_list], dtype=np.int32
+                ),
+                task_type=np.array(
+                    [r.task_type for r in rep_list], dtype=np.int32
+                ),
+                max_wait_rounds=max_wait,
+                selectors=[r.selectors for r in rep_list],
+            )
+            mt = MachineTable(
+                uuids=[m.uuid for m in machines],
+                cpu_capacity=np.array(
+                    [m.cpu_capacity for m in machines], np.int64
+                ),
+                ram_capacity=np.array(
+                    [m.ram_capacity for m in machines], np.int64
+                ),
+                cpu_used=np.zeros(len(machines), dtype=np.int64),
+                ram_used=np.zeros(len(machines), dtype=np.int64),
+                cpu_util=np.array([m.cpu_util for m in machines], np.float32),
+                mem_util=np.array([m.mem_util for m in machines], np.float32),
+                slots_free=np.array(
+                    [m.task_slots for m in machines], np.int32
+                ),
+                labels=[m.labels for m in machines],
+            )
+            return RoundView(
+                ecs=ecs,
+                machines=mt,
+                member_uids=member_uids,
+                member_cur=member_cur,
+                member_wait=member_wait,
+                generation=self.generation,
+            )

@@ -392,46 +392,12 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
     return F, Ffb, prices, iters
 
 
-def solve_transport(
-    costs: np.ndarray,
-    supply: np.ndarray,
-    capacity: np.ndarray,
-    unsched_cost: np.ndarray,
-    init_prices: Optional[np.ndarray] = None,
-    *,
-    arc_capacity: Optional[np.ndarray] = None,
-    init_flows: Optional[np.ndarray] = None,
-    init_unsched: Optional[np.ndarray] = None,
-    eps_start: Optional[int] = None,
-    bid_ranks: int = 8,
-    max_iter_per_phase: int = 8192,
-    scale: Optional[int] = None,
-) -> TransportSolution:
-    """Solve the EC->machine transportation problem on device.
+def _host_validate(costs, supply, capacity, unsched_cost, scale, eps_start):
+    """Input validation + scale/epsilon-schedule derivation (host side).
 
-    Every unit of supply ends up either on a machine or on the per-EC
-    unscheduled fallback arc, so the instance is always feasible and this
-    computes a true min-cost max-flow of the Firmament network.
+    Shared by the single-chip and mesh-sharded entry points.  Returns
+    ``(scale, eps_sched)``.
     """
-    costs = np.asarray(costs, dtype=np.int32)
-    supply = np.asarray(supply, dtype=np.int32)
-    capacity = np.asarray(capacity, dtype=np.int32)
-    unsched_cost = np.asarray(unsched_cost, dtype=np.int32)
-    E, M = costs.shape
-    if E == 0 or M == 0:
-        # Degenerate rounds (idle cluster / no machines yet): everything that
-        # exists goes unscheduled.  The device kernel reduces over these axes
-        # and cannot be traced with zero extents.
-        return TransportSolution(
-            flows=np.zeros((E, M), dtype=np.int32),
-            unsched=supply.copy(),
-            prices=np.zeros(E + M + 1, dtype=np.int32),
-            objective=int(
-                (unsched_cost.astype(np.int64) * supply.astype(np.int64)).sum()
-            ),
-            gap_bound=0.0,
-            iterations=0,
-        )
     finite = costs[costs < INF_COST]
     if finite.size and finite.max() > COST_CAP:
         raise ValueError(f"raw costs must be <= {COST_CAP}")
@@ -440,12 +406,11 @@ def solve_transport(
     if (finite.size and finite.min() < 0) or unsched_cost.min(initial=0) < 0:
         raise ValueError("costs must be non-negative")
 
+    E, M = costs.shape
     max_raw = int(max(finite.max() if finite.size else 0,
                       unsched_cost.max(initial=0), 1))
     if scale is None:
         scale = choose_scale(E, M, max_raw)
-    if init_prices is None:
-        init_prices = np.zeros(E + M + 1, dtype=np.int32)
 
     # Epsilon schedule from the instance's actual cost magnitude (host side:
     # static length per bucket, so distinct magnitudes cost at most a handful
@@ -462,29 +427,14 @@ def solve_transport(
     eps_list = [max(1, eps0 // 16**k) for k in range(32)]
     num_phases = next(i for i, e in enumerate(eps_list) if e == 1) + 1
     eps_sched = np.asarray(eps_list[:num_phases], dtype=np.int32)
+    return scale, eps_sched
 
-    J = max(2, min(bid_ranks, M + 1))
 
-    if init_flows is None:
-        init_flows = np.zeros((E, M), dtype=np.int32)
-    if init_unsched is None:
-        init_unsched = np.zeros(E, dtype=np.int32)
-    if arc_capacity is None:
-        arc_capacity = np.full((E, M), _POS, dtype=np.int32)
-    else:
-        arc_capacity = np.asarray(arc_capacity, dtype=np.int32)
-        if (arc_capacity < 0).any():
-            raise ValueError("arc_capacity must be non-negative")
-
-    flows, unsched, prices, iters = _solve_device(
-        jnp.asarray(costs), jnp.asarray(supply), jnp.asarray(capacity),
-        jnp.asarray(unsched_cost), jnp.asarray(arc_capacity),
-        jnp.asarray(init_prices, dtype=jnp.int32),
-        jnp.asarray(init_flows, dtype=jnp.int32),
-        jnp.asarray(init_unsched, dtype=jnp.int32),
-        jnp.asarray(eps_sched),
-        J=J, max_iter=max_iter_per_phase, scale=int(scale),
-    )
+def _host_finalize(flows, unsched, prices, iters, *,
+                   costs, supply, capacity, unsched_cost,
+                   scale) -> TransportSolution:
+    """Device results -> repaired, certified TransportSolution (host side)."""
+    E, M = costs.shape
     flows = np.asarray(flows)
     unsched = np.asarray(unsched)
 
@@ -538,4 +488,79 @@ def solve_transport(
         objective=objective,
         gap_bound=gap_bound,
         iterations=int(iters),
+    )
+
+
+def solve_transport(
+    costs: np.ndarray,
+    supply: np.ndarray,
+    capacity: np.ndarray,
+    unsched_cost: np.ndarray,
+    init_prices: Optional[np.ndarray] = None,
+    *,
+    arc_capacity: Optional[np.ndarray] = None,
+    init_flows: Optional[np.ndarray] = None,
+    init_unsched: Optional[np.ndarray] = None,
+    eps_start: Optional[int] = None,
+    bid_ranks: int = 8,
+    max_iter_per_phase: int = 8192,
+    scale: Optional[int] = None,
+) -> TransportSolution:
+    """Solve the EC->machine transportation problem on device.
+
+    Every unit of supply ends up either on a machine or on the per-EC
+    unscheduled fallback arc, so the instance is always feasible and this
+    computes a true min-cost max-flow of the Firmament network.
+    """
+    costs = np.asarray(costs, dtype=np.int32)
+    supply = np.asarray(supply, dtype=np.int32)
+    capacity = np.asarray(capacity, dtype=np.int32)
+    unsched_cost = np.asarray(unsched_cost, dtype=np.int32)
+    E, M = costs.shape
+    if E == 0 or M == 0:
+        # Degenerate rounds (idle cluster / no machines yet): everything that
+        # exists goes unscheduled.  The device kernel reduces over these axes
+        # and cannot be traced with zero extents.
+        return TransportSolution(
+            flows=np.zeros((E, M), dtype=np.int32),
+            unsched=supply.copy(),
+            prices=np.zeros(E + M + 1, dtype=np.int32),
+            objective=int(
+                (unsched_cost.astype(np.int64) * supply.astype(np.int64)).sum()
+            ),
+            gap_bound=0.0,
+            iterations=0,
+        )
+    scale, eps_sched = _host_validate(
+        costs, supply, capacity, unsched_cost, scale, eps_start
+    )
+    if init_prices is None:
+        init_prices = np.zeros(E + M + 1, dtype=np.int32)
+
+    J = max(2, min(bid_ranks, M + 1))
+
+    if init_flows is None:
+        init_flows = np.zeros((E, M), dtype=np.int32)
+    if init_unsched is None:
+        init_unsched = np.zeros(E, dtype=np.int32)
+    if arc_capacity is None:
+        arc_capacity = np.full((E, M), _POS, dtype=np.int32)
+    else:
+        arc_capacity = np.asarray(arc_capacity, dtype=np.int32)
+        if (arc_capacity < 0).any():
+            raise ValueError("arc_capacity must be non-negative")
+
+    flows, unsched, prices, iters = _solve_device(
+        jnp.asarray(costs), jnp.asarray(supply), jnp.asarray(capacity),
+        jnp.asarray(unsched_cost), jnp.asarray(arc_capacity),
+        jnp.asarray(init_prices, dtype=jnp.int32),
+        jnp.asarray(init_flows, dtype=jnp.int32),
+        jnp.asarray(init_unsched, dtype=jnp.int32),
+        jnp.asarray(eps_sched),
+        J=J, max_iter=max_iter_per_phase, scale=int(scale),
+    )
+    return _host_finalize(
+        flows, unsched, prices, iters,
+        costs=costs, supply=supply, capacity=capacity,
+        unsched_cost=unsched_cost, scale=scale,
     )

@@ -1,0 +1,162 @@
+"""Multi-chip sharded min-cost max-flow: the machine axis over a device mesh.
+
+The scale axis of this framework is the flow-network size — tasks x machines
+(SURVEY.md section 2.3: "data-parallel sharding of the flow network ... this
+project's 'ring attention equivalent'").  The dense transportation kernel in
+ops/transport.py is pure jnp over ``[E, M]`` arrays, so multi-chip scale-out
+is expressed the JAX-native way: lay the machine (column) axis across a
+``jax.sharding.Mesh``, annotate the operands with ``NamedSharding``, and jit
+the very same kernel — XLA's SPMD partitioner partitions every elementwise
+op M-wise on ICI and inserts the collectives the algorithm needs
+(all-gathers for the per-row global ``top_k`` candidate selection, psums for
+the excess/termination reductions).  One kernel, one code path, any mesh.
+
+Replaces (TPU-native): the reference scheduler's single-process C++ solver
+(reference deploy/firmament-deployment.yaml:29-31) — which has no scale-out
+story at all — with an ICI-sharded solve; DCN multi-slice falls out of the
+same mesh mechanism.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from poseidon_tpu.ops import transport
+from poseidon_tpu.ops.transport import (
+    INF_COST,
+    TransportSolution,
+    _POS,
+    _host_finalize,
+    _host_validate,
+    _solve_device,
+)
+
+MACHINE_AXIS = "machines"
+
+
+def make_solver_mesh(num_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the machine axis.
+
+    ``num_devices=None`` takes every visible device.  A multi-slice
+    (ICI x DCN) machine sharding is just a reshaped device list with the
+    same axis name; the kernel is agnostic.
+    """
+    devices = jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (MACHINE_AXIS,))
+
+
+def _pad_columns(arr: np.ndarray, m_pad: int, fill) -> np.ndarray:
+    if arr.ndim == 1:
+        out = np.full(m_pad, fill, dtype=arr.dtype)
+        out[: arr.shape[0]] = arr
+    else:
+        out = np.full((arr.shape[0], m_pad), fill, dtype=arr.dtype)
+        out[:, : arr.shape[1]] = arr
+    return out
+
+
+def solve_transport_sharded(
+    costs: np.ndarray,
+    supply: np.ndarray,
+    capacity: np.ndarray,
+    unsched_cost: np.ndarray,
+    init_prices: Optional[np.ndarray] = None,
+    *,
+    mesh: Mesh,
+    arc_capacity: Optional[np.ndarray] = None,
+    init_flows: Optional[np.ndarray] = None,
+    init_unsched: Optional[np.ndarray] = None,
+    eps_start: Optional[int] = None,
+    bid_ranks: int = 8,
+    max_iter_per_phase: int = 8192,
+    scale: Optional[int] = None,
+) -> TransportSolution:
+    """Drop-in mesh-sharded variant of ``transport.solve_transport``.
+
+    Machines are padded to a multiple of the mesh size with zero-capacity /
+    inadmissible columns (dead columns never carry flow, so padding is
+    semantically invisible); every ``[*, M]`` operand is device_put with its
+    machine axis laid over ``mesh`` and the shared jitted kernel runs SPMD
+    across the mesh's devices.  Solutions are bit-identical to the
+    single-chip path (same kernel, same arithmetic).
+    """
+    costs = np.asarray(costs, dtype=np.int32)
+    supply = np.asarray(supply, dtype=np.int32)
+    capacity = np.asarray(capacity, dtype=np.int32)
+    unsched_cost = np.asarray(unsched_cost, dtype=np.int32)
+    E, M = costs.shape
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    if E == 0 or M == 0 or n_dev <= 1:
+        return transport.solve_transport(
+            costs, supply, capacity, unsched_cost, init_prices,
+            arc_capacity=arc_capacity, init_flows=init_flows,
+            init_unsched=init_unsched, eps_start=eps_start,
+            bid_ranks=bid_ranks, max_iter_per_phase=max_iter_per_phase,
+            scale=scale,
+        )
+
+    scale, eps_sched = _host_validate(
+        costs, supply, capacity, unsched_cost, scale, eps_start
+    )
+
+    m_pad = ((M + n_dev - 1) // n_dev) * n_dev
+    costs_p = _pad_columns(costs, m_pad, INF_COST)
+    capacity_p = _pad_columns(capacity, m_pad, 0)
+    if arc_capacity is None:
+        arc_cap_p = np.full((E, m_pad), _POS, dtype=np.int32)
+    else:
+        arc_capacity = np.asarray(arc_capacity, dtype=np.int32)
+        if (arc_capacity < 0).any():
+            raise ValueError("arc_capacity must be non-negative")
+        arc_cap_p = _pad_columns(arc_capacity, m_pad, 0)
+    if init_flows is None:
+        flows_p = np.zeros((E, m_pad), dtype=np.int32)
+    else:
+        flows_p = _pad_columns(np.asarray(init_flows, dtype=np.int32), m_pad, 0)
+    if init_unsched is None:
+        init_unsched = np.zeros(E, dtype=np.int32)
+    prices_p = np.zeros(E + m_pad + 1, dtype=np.int32)
+    if init_prices is not None:
+        init_prices = np.asarray(init_prices, dtype=np.int32)
+        prices_p[:E] = init_prices[:E]
+        prices_p[E : E + M] = init_prices[E : E + M]
+        prices_p[E + m_pad] = init_prices[E + M]
+
+    col = NamedSharding(mesh, P(None, MACHINE_AXIS))   # [E, M] matrices
+    vec_m = NamedSharding(mesh, P(MACHINE_AXIS))       # [M] vectors
+    repl = NamedSharding(mesh, P())                    # replicated
+
+    J = max(2, min(bid_ranks, m_pad + 1))
+    put = jax.device_put
+    flows, unsched, prices, iters = _solve_device(
+        put(jnp.asarray(costs_p), col),
+        put(jnp.asarray(supply), repl),
+        put(jnp.asarray(capacity_p), vec_m),
+        put(jnp.asarray(unsched_cost), repl),
+        put(jnp.asarray(arc_cap_p), col),
+        # Prices mix both node classes in one [E+M+1] vector; replicated
+        # (it is O(E+M) — the O(E*M) matrices are what must shard).
+        put(jnp.asarray(prices_p), repl),
+        put(jnp.asarray(flows_p), col),
+        put(jnp.asarray(init_unsched, dtype=jnp.int32), repl),
+        put(jnp.asarray(eps_sched), repl),
+        J=J, max_iter=max_iter_per_phase, scale=int(scale),
+    )
+
+    flows = np.asarray(flows)[:, :M]
+    prices_full = np.asarray(prices)
+    prices_out = np.concatenate(
+        [prices_full[:E], prices_full[E : E + M], prices_full[E + m_pad :]]
+    )
+    return _host_finalize(
+        flows, unsched, prices_out, iters,
+        costs=costs, supply=supply, capacity=capacity,
+        unsched_cost=unsched_cost, scale=scale,
+    )

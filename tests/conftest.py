@@ -2,14 +2,21 @@
 
 Tests must never depend on TPU hardware; multi-chip sharding is validated on
 a virtual CPU mesh (the driver separately dry-runs the multichip path).
-These env vars must be set before jax is first imported.
+The environment may pre-import jax with a TPU platform pinned (sitecustomize
+registering an accelerator plugin), so plain env vars are too late —
+``jax.config.update`` still works as long as no backend has been used yet,
+which is guaranteed here because conftest runs before any test module.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

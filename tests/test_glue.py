@@ -1,0 +1,297 @@
+"""Integration tier: FakeKube + Poseidon glue + real firmament-tpu service.
+
+The reference's e2e suite drives real workloads through a cluster
+(test/e2e/poseidon_integration.go: bare Pod, Deployment/ReplicaSet/Job
+grouping, resource-limit packing, NodeSelector respected/not-matching).
+This tier runs the same scenarios fully in-process: the fake cluster feeds
+the watchers, the real gRPC service schedules, and the loop enacts deltas
+back into the fake cluster.
+"""
+
+import threading
+import time
+
+import grpc
+import pytest
+
+from poseidon_tpu.glue import FakeKube, Node, Pod, Poseidon
+from poseidon_tpu.glue.keyed_queue import KeyedQueue
+from poseidon_tpu.protos import stats_pb2 as spb
+from poseidon_tpu.protos.services import STATS_METHODS, STATS_SERVICE, make_stubs
+from poseidon_tpu.service import FirmamentClient, FirmamentTPUServer
+from poseidon_tpu.utils.config import PoseidonConfig
+
+
+# ---------------------------------------------------------------- keyed queue
+
+
+class TestKeyedQueue:
+    def test_batching_and_ordering(self):
+        q = KeyedQueue()
+        q.add("a", 1)
+        q.add("a", 2)
+        q.add("b", 3)
+        key, items = q.get()
+        assert (key, items) == ("a", [1, 2])
+        key2, items2 = q.get()
+        assert (key2, items2) == ("b", [3])
+
+    def test_processing_key_parks(self):
+        q = KeyedQueue()
+        q.add("a", 1)
+        key, _ = q.get()          # "a" now processing
+        q.add("a", 2)             # parks
+        q.add("b", 3)
+        key2, items2 = q.get()
+        assert key2 == "b"        # parked "a" not re-issued yet
+        q.done("a")               # releases parked items
+        key3, items3 = q.get()
+        assert (key3, items3) == ("a", [2])
+
+    def test_shutdown_unblocks(self):
+        q = KeyedQueue()
+        out = []
+
+        def getter():
+            out.append(q.get())
+
+        t = threading.Thread(target=getter)
+        t.start()
+        time.sleep(0.05)
+        q.shut_down()
+        t.join(timeout=2)
+        assert out == [None]
+
+
+# ------------------------------------------------------------ the full system
+
+
+@pytest.fixture()
+def system():
+    with FirmamentTPUServer(address="127.0.0.1:0") as server:
+        kube = FakeKube()
+        cfg = PoseidonConfig(
+            firmament_address=server.address, scheduling_interval=3600
+        )
+        # Loop disabled: tests drive rounds explicitly via schedule_once().
+        poseidon = Poseidon(
+            kube, config=cfg, stats_address="127.0.0.1:0", run_loop=False
+        ).start(health_timeout=10)
+        try:
+            yield kube, poseidon, server
+        finally:
+            poseidon.stop()
+
+
+def test_bare_pod_is_scheduled(system):
+    kube, poseidon, _ = system
+    kube.add_node(Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.create_pod(Pod(name="p1", cpu_request=100, ram_request=1 << 20))
+    assert poseidon.drain_watchers()
+    deltas = poseidon.schedule_once()
+    assert len(deltas) == 1
+    assert kube.bindings == [("default/p1", "n1")]
+    assert kube.pods["default/p1"].phase == "Running"
+
+
+def test_owner_grouped_pods_one_job(system):
+    kube, poseidon, _ = system
+    for i in range(3):
+        kube.add_node(
+            Node(name=f"n{i}", cpu_capacity=4000, ram_capacity=1 << 24)
+        )
+    for i in range(6):
+        kube.create_pod(
+            Pod(
+                name=f"web-{i}", owner_uid="rs-uid-1",
+                cpu_request=500, ram_request=1 << 20,
+            )
+        )
+    assert poseidon.drain_watchers()
+    poseidon.schedule_once()
+    assert len(kube.bindings) == 6
+    assert all(p.phase == "Running" for p in kube.pods.values())
+
+
+def test_unschedulable_pod_stays_pending(system):
+    """Packing predicate (poseidon_integration.go:294-407): an oversized
+    pod must stay Pending while a fitting one schedules."""
+    kube, poseidon, _ = system
+    kube.add_node(Node(name="small", cpu_capacity=1000, ram_capacity=1 << 20))
+    kube.create_pod(Pod(name="fits", cpu_request=500, ram_request=1 << 18))
+    kube.create_pod(Pod(name="huge", cpu_request=64000, ram_request=1 << 30))
+    assert poseidon.drain_watchers()
+    poseidon.schedule_once()
+    assert kube.pods["default/fits"].phase == "Running"
+    assert kube.pods["default/huge"].phase == "Pending"
+    assert ("default/huge", "small") not in kube.bindings
+
+
+def test_node_selector_respected(system):
+    """NodeSelector predicates (poseidon_integration.go:409-478)."""
+    kube, poseidon, _ = system
+    kube.add_node(
+        Node(name="ssd-node", cpu_capacity=4000, ram_capacity=1 << 24,
+             labels={"disktype": "ssd"})
+    )
+    kube.add_node(
+        Node(name="hdd-node", cpu_capacity=4000, ram_capacity=1 << 24)
+    )
+    kube.create_pod(
+        Pod(name="picky", cpu_request=100, ram_request=1 << 18,
+            node_selector={"disktype": "ssd"})
+    )
+    kube.create_pod(
+        Pod(name="impossible", cpu_request=100, ram_request=1 << 18,
+            node_selector={"disktype": "nvme"})
+    )
+    assert poseidon.drain_watchers()
+    poseidon.schedule_once()
+    assert ("default/picky", "ssd-node") in kube.bindings
+    assert kube.pods["default/impossible"].phase == "Pending"
+
+
+def test_unschedulable_node_skipped(system):
+    kube, poseidon, _ = system
+    kube.add_node(
+        Node(name="cordoned", cpu_capacity=4000, ram_capacity=1 << 24,
+             unschedulable=True)
+    )
+    kube.add_node(Node(name="open", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.create_pod(Pod(name="p", cpu_request=100, ram_request=1 << 18))
+    assert poseidon.drain_watchers()
+    poseidon.schedule_once()
+    assert kube.bindings == [("default/p", "open")]
+
+
+def test_node_failure_reschedules(system):
+    kube, poseidon, _ = system
+    kube.add_node(Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.create_pod(
+        Pod(name="p", owner_uid="job-1", cpu_request=100, ram_request=1 << 18)
+    )
+    assert poseidon.drain_watchers()
+    poseidon.schedule_once()
+    assert kube.bindings == [("default/p", "n1")]
+
+    kube.add_node(Node(name="n2", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.update_node("n1", lambda n: setattr(n, "ready", False))
+    assert poseidon.drain_watchers()
+    deltas = poseidon.schedule_once()
+    # The service re-placed the evicted task; the PLACE lands on n2.
+    assert any(d.type == 1 for d in deltas)
+    assert ("default/p", "n2") in kube.bindings
+
+
+def test_node_recovery_rearms(system):
+    """A NotReady blip must not permanently remove the node: recovery sends
+    NodeUpdated and the node schedules again (regression: the failed
+    condition was never stored, so recovery was undetectable)."""
+    kube, poseidon, _ = system
+    kube.add_node(Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24))
+    assert poseidon.drain_watchers()
+    kube.update_node("n1", lambda n: setattr(n, "ready", False))
+    assert poseidon.drain_watchers()
+    kube.update_node("n1", lambda n: setattr(n, "ready", True))
+    assert poseidon.drain_watchers()
+    kube.create_pod(Pod(name="p", cpu_request=100, ram_request=1 << 18))
+    assert poseidon.drain_watchers()
+    poseidon.schedule_once()
+    assert kube.bindings == [("default/p", "n1")]
+
+
+def test_pod_spec_update_propagates(system):
+    """Mutating a pod's requests must send TaskUpdated (regression: FakeKube
+    delivered live references, so old-vs-new comparison never fired)."""
+    kube, poseidon, server = system
+    kube.add_node(Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.create_pod(Pod(name="p", cpu_request=100, ram_request=1 << 18))
+    assert poseidon.drain_watchers()
+    poseidon.schedule_once()
+
+    kube.update_pod(
+        "default/p", lambda p: setattr(p, "cpu_request", 3500)
+    )
+    assert poseidon.drain_watchers()
+    uid = poseidon.shared.uid_for_pod("default/p")
+    assert server.servicer.state.tasks[uid].cpu_request == 3500
+
+
+def test_completed_pod_releases_task(system):
+    kube, poseidon, _ = system
+    kube.add_node(Node(name="n1", cpu_capacity=1000, ram_capacity=1 << 24))
+    kube.create_pod(Pod(name="p1", cpu_request=100, ram_request=1 << 18))
+    assert poseidon.drain_watchers()
+    poseidon.schedule_once()
+    kube.set_pod_phase("default/p1", "Succeeded")
+    assert poseidon.drain_watchers()
+    # Completed task produces no further deltas.
+    assert poseidon.schedule_once() == []
+
+
+def test_deleted_pod_removed(system):
+    kube, poseidon, _ = system
+    kube.add_node(Node(name="n1", cpu_capacity=1000, ram_capacity=1 << 24))
+    kube.create_pod(Pod(name="p1", cpu_request=100, ram_request=1 << 18))
+    assert poseidon.drain_watchers()
+    poseidon.schedule_once()
+    kube.delete_pod("default", "p1")
+    assert poseidon.drain_watchers()
+    assert poseidon.schedule_once() == []
+    assert poseidon.shared.uid_for_pod("default/p1") is None
+
+
+def test_stats_stream_roundtrip(system):
+    """Heapster-style stream -> stats server -> firmament knowledge base
+    (stats.go:77-159), then the cost model steers away from the hot node."""
+    kube, poseidon, server = system
+    kube.add_node(Node(name="hot", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.add_node(Node(name="cold", cpu_capacity=4000, ram_capacity=1 << 24))
+    assert poseidon.drain_watchers()
+
+    channel = grpc.insecure_channel(poseidon.stats_server.address)
+    stubs = make_stubs(channel, STATS_SERVICE, STATS_METHODS)
+    samples = [
+        spb.NodeStats(hostname="hot", cpu_utilization=0.95,
+                      mem_utilization=0.95)
+        for _ in range(4)
+    ] + [spb.NodeStats(hostname="nope", cpu_utilization=0.1)]
+    replies = list(stubs.ReceiveNodeStats(iter(samples)))
+    assert [r.type for r in replies] == [spb.NODE_STATS_OK] * 4 + [
+        spb.NODE_NOT_FOUND
+    ]
+
+    # Pod stats for an unknown pod answer POD_NOT_FOUND.
+    pod_replies = list(
+        stubs.ReceivePodStats(iter([spb.PodStats(name="x", namespace="y")]))
+    )
+    assert [r.type for r in pod_replies] == [spb.POD_NOT_FOUND]
+    channel.close()
+
+    kube.create_pod(Pod(name="p", cpu_request=100, ram_request=1 << 18))
+    assert poseidon.drain_watchers()
+    poseidon.schedule_once()
+    assert kube.bindings == [("default/p", "cold")]
+
+
+def test_preemption_recreate_cycle(system):
+    """PREEMPT deletes the pod; the owning controller recreates it and the
+    replacement is scheduled next round (poseidon.go:52-63 emulation)."""
+    kube, poseidon, server = system
+    kube.recreate_on_delete = True
+    kube.add_node(Node(name="n1", cpu_capacity=1000, ram_capacity=1 << 24))
+    kube.create_pod(
+        Pod(name="p", owner_uid="rs-1", cpu_request=800, ram_request=1 << 18)
+    )
+    assert poseidon.drain_watchers()
+    poseidon.schedule_once()
+    assert kube.bindings == [("default/p", "n1")]
+
+    # Direct deletion (e.g. kubectl): watcher sends TaskRemoved, controller
+    # recreates, next round places the clone.
+    kube.delete_pod("default", "p")
+    assert poseidon.drain_watchers()
+    clone_keys = [k for k in kube.pods if k != "default/p"]
+    assert len(clone_keys) == 1
+    poseidon.schedule_once()
+    assert kube.pods[clone_keys[0]].phase == "Running"

@@ -1,0 +1,100 @@
+"""Mesh-sharded solver: parity with the single-chip path and the oracle.
+
+Runs on the 8-device virtual CPU mesh from conftest.py (the driver
+separately dry-runs the multichip path; tests never need TPU hardware).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from poseidon_tpu.ops.transport import INF_COST, solve_transport
+from poseidon_tpu.ops.transport_sharded import (
+    make_solver_mesh,
+    solve_transport_sharded,
+)
+from poseidon_tpu.solver.oracle import transport_objective
+
+
+def random_instance(rng, E, M, max_cost=1000):
+    costs = rng.integers(0, max_cost, size=(E, M)).astype(np.int32)
+    # ~10% inadmissible arcs.
+    costs[rng.random((E, M)) < 0.1] = INF_COST
+    supply = rng.integers(1, 8, size=E).astype(np.int32)
+    capacity = rng.integers(1, 10, size=M).astype(np.int32)
+    unsched = rng.integers(max_cost, 2 * max_cost, size=E).astype(np.int32)
+    return costs, supply, capacity, unsched
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return make_solver_mesh(8)
+
+
+def test_sharded_matches_oracle(mesh):
+    rng = np.random.default_rng(7)
+    for E, M in [(5, 12), (9, 30), (16, 64)]:
+        costs, supply, capacity, unsched = random_instance(rng, E, M)
+        sol = solve_transport_sharded(
+            costs, supply, capacity, unsched, mesh=mesh
+        )
+        want = transport_objective(costs, supply, capacity, unsched)
+        assert sol.gap_bound == 0.0
+        assert sol.objective == want, (E, M)
+
+
+def test_sharded_matches_single_chip(mesh):
+    rng = np.random.default_rng(11)
+    costs, supply, capacity, unsched = random_instance(rng, 12, 40)
+    single = solve_transport(costs, supply, capacity, unsched)
+    sharded = solve_transport_sharded(
+        costs, supply, capacity, unsched, mesh=mesh
+    )
+    assert sharded.objective == single.objective
+    # Feasibility of the sharded assignment.
+    assert (sharded.flows.sum(axis=0) <= capacity).all()
+    np.testing.assert_array_equal(
+        sharded.flows.sum(axis=1) + sharded.unsched, supply
+    )
+
+
+def test_sharded_respects_arc_capacity(mesh):
+    rng = np.random.default_rng(13)
+    costs, supply, capacity, unsched = random_instance(rng, 6, 16)
+    arc_cap = rng.integers(0, 3, size=costs.shape).astype(np.int32)
+    sol = solve_transport_sharded(
+        costs, supply, capacity, unsched, mesh=mesh, arc_capacity=arc_cap
+    )
+    assert (sol.flows <= arc_cap).all()
+    want = transport_objective(
+        costs, supply, capacity, unsched, arc_capacity=arc_cap
+    )
+    assert sol.objective == want
+
+
+def test_sharded_warm_start(mesh):
+    rng = np.random.default_rng(17)
+    costs, supply, capacity, unsched = random_instance(rng, 10, 24)
+    cold = solve_transport_sharded(costs, supply, capacity, unsched, mesh=mesh)
+    # Perturb a few costs and re-solve warm from the previous solution.
+    costs2 = costs.copy()
+    mask = (costs2 < INF_COST) & (rng.random(costs2.shape) < 0.05)
+    costs2[mask] = np.minimum(costs2[mask] + 50, 1000)
+    warm = solve_transport_sharded(
+        costs2, supply, capacity, unsched, cold.prices, mesh=mesh,
+        init_flows=cold.flows, init_unsched=cold.unsched,
+    )
+    want = transport_objective(costs2, supply, capacity, unsched)
+    assert warm.objective == want
+
+
+def test_single_device_mesh_falls_back():
+    mesh1 = make_solver_mesh(1)
+    rng = np.random.default_rng(19)
+    costs, supply, capacity, unsched = random_instance(rng, 4, 6)
+    sol = solve_transport_sharded(
+        costs, supply, capacity, unsched, mesh=mesh1
+    )
+    want = transport_objective(costs, supply, capacity, unsched)
+    assert sol.objective == want
