@@ -16,6 +16,8 @@ pkg/k8sclient/podwatcher.go:455-465, label_selector.proto:23-34).
 from poseidon_tpu.costmodel.base import CostMatrices, CostModel, get_cost_model
 from poseidon_tpu.costmodel.cpu_mem import CpuMemCostModel
 from poseidon_tpu.costmodel.trivial import TrivialCostModel
+from poseidon_tpu.costmodel.interference import CoCoCostModel, WhareMapCostModel
+from poseidon_tpu.costmodel.net import NetAwareCostModel
 from poseidon_tpu.costmodel.selectors import selector_admissibility
 
 __all__ = [
@@ -23,6 +25,9 @@ __all__ = [
     "CostModel",
     "CpuMemCostModel",
     "TrivialCostModel",
+    "WhareMapCostModel",
+    "CoCoCostModel",
+    "NetAwareCostModel",
     "get_cost_model",
     "selector_admissibility",
 ]

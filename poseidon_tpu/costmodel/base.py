@@ -39,6 +39,18 @@ class ECTable:
     selectors: List[Tuple[Tuple[int, str, Tuple[str, ...]], ...]] = field(
         default_factory=list
     )
+    # int64 [E] net receive bandwidth request per task (net-aware model).
+    net_rx_request: Optional[np.ndarray] = None
+    # int32 [E, M] count of this EC's *running* members per machine.  Lets
+    # resource-accounting models exclude an EC's own committed usage from
+    # its fit check (a running task must not be evicted by its own
+    # reservation).
+    running_by_machine: Optional[np.ndarray] = None
+
+    def net_rx(self) -> np.ndarray:
+        if self.net_rx_request is None:
+            return np.zeros(self.num_ecs, dtype=np.int64)
+        return self.net_rx_request
 
     @property
     def num_ecs(self) -> int:
@@ -58,10 +70,23 @@ class MachineTable:
     mem_util: np.ndarray        # float32 [M] measured utilization 0..1
     slots_free: np.ndarray      # int32 [M] free task slots
     labels: List[Dict[str, str]] = field(default_factory=list)
+    # Net receive bandwidth (net-aware model); zero = unknown/unlimited.
+    net_rx_capacity: Optional[np.ndarray] = None   # int64 [M]
+    net_rx_used: Optional[np.ndarray] = None       # int64 [M]
+    # Interference inputs: resident-task census by type (live placements
+    # plus any descriptor-carried WhareMapStats) and per-machine CoCo
+    # penalty vectors (devil, rabbit, sheep, turtle).
+    type_census: Optional[np.ndarray] = None       # int64 [M, 4]
+    coco_penalties: Optional[np.ndarray] = None    # int64 [M, 4]
 
     @property
     def num_machines(self) -> int:
         return len(self.uuids)
+
+    def census(self) -> np.ndarray:
+        if self.type_census is None:
+            return np.zeros((self.num_machines, 4), dtype=np.int64)
+        return self.type_census
 
 
 @dataclass

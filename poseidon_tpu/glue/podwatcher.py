@@ -120,6 +120,13 @@ class PodWatcher:
             td.label_selectors.add(
                 type=fpb.LabelSelector.IN_SET, key=k, values=[v]
             )
+        # Already-bound pods (seen on restart re-list) carry their binding
+        # so the scheduler state machine can recover the placement
+        # (task_desc.proto's scheduled_to_resource field).
+        if pod.node_name:
+            res = self.shared.resource_for_node(pod.node_name)
+            if res is not None:
+                td.scheduled_to_resource = res
         jd = fpb.JobDescriptor(uuid=entry.uuid, name=pod.owner_uid or pod.key)
         return fpb.TaskDescription(task_descriptor=td, job_descriptor=jd)
 
@@ -181,27 +188,32 @@ class PodWatcher:
         if kind == "DELETED" or pod.deleted:
             if sh.pop_task(uid) is not None:
                 self.fc.task_removed(uid)
-                self._gc_job(pod)
+            self._gc_job(pod)
             return
-        if pod.phase == "Pending" and not pod.node_name:
-            desc = self._descriptor(pod)
-            if sh.get_task(uid) is None:
+        if pod.phase == "Succeeded":
+            known = sh.get_task(uid)
+            if known is not None and not known.finished:
+                self.fc.task_completed(uid)
+                sh.mark_finished(uid)
+            return
+        if pod.phase == "Failed":
+            known = sh.get_task(uid)
+            if known is not None and not known.finished:
+                self.fc.task_failed(uid)
+                sh.mark_finished(uid)
+            return
+        if pod.phase in ("Pending", "Running"):
+            known = sh.get_task(uid)
+            if known is None:
+                # Fresh Pending pod — or an already-bound pod re-listed
+                # after a glue restart, whose binding the descriptor
+                # carries via scheduled_to_resource.
+                desc = self._descriptor(pod)
                 sh.put_task(uid, pod, desc.task_descriptor)
                 self.fc.task_submitted(
                     desc.task_descriptor, desc.job_descriptor
                 )
-            return
-        if pod.phase == "Succeeded":
-            if sh.get_task(uid) is not None:
-                self.fc.task_completed(uid)
-            return
-        if pod.phase == "Failed":
-            if sh.get_task(uid) is not None:
-                self.fc.task_failed(uid)
-            return
-        if kind == "MODIFIED" and pod.phase in ("Pending", "Running"):
-            known = sh.get_task(uid)
-            if known is not None and self._spec_changed(known.pod, pod):
+            elif kind == "MODIFIED" and self._spec_changed(known.pod, pod):
                 desc = self._descriptor(pod)
                 sh.put_task(uid, pod, desc.task_descriptor)
                 self.fc.task_updated(desc.task_descriptor, desc.job_descriptor)

@@ -85,6 +85,12 @@ class Poseidon:
         if self.stats_server is not None:
             self.stats_server.start()
         self.node_watcher.run()
+        # Initial node sync before pods start flowing (the informer
+        # cache-sync ordering): a re-listed bound pod resolves its node's
+        # resource uuid through SharedState, which must be populated first.
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and len(self.node_watcher.queue):
+            time.sleep(0.01)
         self.pod_watcher.run()
         if self.run_loop:
             self._loop_thread = threading.Thread(

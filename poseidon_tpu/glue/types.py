@@ -20,6 +20,12 @@ from poseidon_tpu.protos import firmament_pb2 as fpb
 class TaskEntry:
     pod: Pod
     descriptor: fpb.TaskDescriptor
+    # Task reached a terminal phase (Succeeded/Failed reported to the
+    # scheduler).  The entry stays until the pod object is DELETED — the
+    # uid must remain resolvable for the TaskRemoved hand-off — but stats
+    # for finished tasks are dropped (the reference answers NOT_FOUND for
+    # pods it no longer tracks, stats.go:132-134).
+    finished: bool = False
 
 
 @dataclass
@@ -54,9 +60,23 @@ class SharedState:
                 self._pod_to_uid.pop(entry.pod.key, None)
             return entry
 
-    def uid_for_pod(self, pod_key: str) -> Optional[int]:
+    def mark_finished(self, uid: int) -> None:
         with self._lock:
-            return self._pod_to_uid.get(pod_key)
+            entry = self._tasks.get(uid)
+            if entry is not None:
+                entry.finished = True
+
+    def uid_for_pod(self, pod_key: str) -> Optional[int]:
+        """Task uid for a live pod; None for unknown or finished pods
+        (the stats path — finished tasks answer NOT_FOUND)."""
+        with self._lock:
+            uid = self._pod_to_uid.get(pod_key)
+            if uid is None:
+                return None
+            entry = self._tasks.get(uid)
+            if entry is None or entry.finished:
+                return None
+            return uid
 
     def task_for_uid(self, uid: int) -> Optional[Pod]:
         with self._lock:

@@ -24,11 +24,12 @@ def ec_signature(
     selectors: Tuple[Selector, ...],
     task_type: int,
     priority: int,
+    net_rx_request: int = 0,
 ) -> int:
     """64-bit EC id for a task's scheduling-relevant attributes.
 
-    Attribute choice mirrors what the CPU/Mem model can distinguish: the
-    request vector's CPU/mem dimensions, the selector set (canonically
+    Attribute choice mirrors what the cost models can distinguish: the
+    request vector's CPU/mem/net dimensions, the selector set (canonically
     sorted), the interference task type (task_desc.proto:45-50) and
     priority.  Tasks differing only in name/labels/owner land in the same
     EC by design.
@@ -36,6 +37,7 @@ def ec_signature(
     h = fnv64a("ec")
     h = hash_combine(h, int(cpu_request))
     h = hash_combine(h, int(ram_request))
+    h = hash_combine(h, int(net_rx_request))
     h = hash_combine(h, int(task_type))
     h = hash_combine(h, int(priority))
     for stype, key, values in sorted(selectors):

@@ -76,6 +76,11 @@ class _WarmState:
     prices: Optional[np.ndarray] = None
     flows: Optional[np.ndarray] = None
     unsched: Optional[np.ndarray] = None
+    # Last round's raw cost matrix + unscheduled-cost vector (post-remap
+    # reference frame): the incremental epsilon heuristic reads the
+    # per-arc cost drift off them.
+    costs: Optional[np.ndarray] = None
+    unsched_cost: Optional[np.ndarray] = None
 
 
 class RoundPlanner:
@@ -87,23 +92,41 @@ class RoundPlanner:
         cost_model: CostModel,
         *,
         preemption: bool = True,
+        incremental: bool = True,
     ) -> None:
         self.state = state
         self.cost_model = cost_model
         self.preemption = preemption
+        # Incremental re-solve (the Flowlessly analog, SURVEY.md section 7
+        # step 7): quiet rounds skip the solve outright, and low-churn
+        # rounds start the epsilon ladder at the observed cost drift
+        # instead of the full cost magnitude.
+        self.incremental = incremental
         self._warm = _WarmState()
+        self._prev_unsched_cost: Optional[np.ndarray] = None
+        self._last_generation = -1
+        self._last_unscheduled = 1  # force a solve on the first round
         self.last_metrics = RoundMetrics()
 
     # ------------------------------------------------------------- warm start
 
     def _remap_warm(
         self, ec_ids: List[int], machine_uuids: List[str]
-    ) -> Tuple[Optional[np.ndarray], Optional[np.ndarray], Optional[np.ndarray]]:
-        """Carry prices/flows from the previous round into this round's
-        index space (ECs/machines may have churned)."""
+    ) -> Tuple[
+        Optional[np.ndarray], Optional[np.ndarray], Optional[np.ndarray],
+        Optional[np.ndarray], bool,
+    ]:
+        """Carry prices/flows/costs from the previous round into this
+        round's index space (ECs/machines may have churned).
+
+        Returns ``(prices, flows, unsched, prev_costs, full_overlap)``;
+        ``prev_costs`` cells with no predecessor are -1, and
+        ``full_overlap`` is True iff every current EC and machine existed
+        last round (the precondition for the incremental epsilon start).
+        """
         w = self._warm
         if w.prices is None:
-            return None, None, None
+            return None, None, None, None, False
         E, M = len(ec_ids), len(machine_uuids)
         prev_e = {e: i for i, e in enumerate(w.ec_ids)}
         prev_m = {u: i for i, u in enumerate(w.machine_uuids)}
@@ -111,6 +134,7 @@ class RoundPlanner:
         prices[E + M] = w.prices[len(w.ec_ids) + len(w.machine_uuids)]
         flows = np.zeros((E, M), dtype=np.int32)
         unsched = np.zeros(E, dtype=np.int32)
+        prev_costs = np.full((E, M), -1, dtype=np.int64)
         # Vectorized gather of the surviving rows/columns (this runs every
         # round; a Python E*M loop would dwarf the solve at scale).
         e_idx = np.array([prev_e.get(e, -1) for e in ec_ids], dtype=np.int64)
@@ -127,13 +151,43 @@ class RoundPlanner:
             unsched[ke_new] = w.unsched[ke_old]
         if w.flows is not None and ke_new.size and km_new.size:
             flows[np.ix_(ke_new, km_new)] = w.flows[np.ix_(ke_old, km_old)]
-        return prices, flows, unsched
+        if w.costs is not None and ke_new.size and km_new.size:
+            prev_costs[np.ix_(ke_new, km_new)] = w.costs[
+                np.ix_(ke_old, km_old)
+            ]
+        self._prev_unsched_cost = np.full(E, -1, dtype=np.int64)
+        if w.unsched_cost is not None and ke_new.size:
+            self._prev_unsched_cost[ke_new] = w.unsched_cost[ke_old]
+        full_overlap = ke_new.size == E and km_new.size == M
+        return prices, flows, unsched, prev_costs, full_overlap
 
     # ------------------------------------------------------------------ round
 
     def schedule_round(self) -> Tuple[List[Delta], RoundMetrics]:
         t0 = time.perf_counter()
         st = self.state
+
+        # Quiet-round fast path: no mutation since the committed result of
+        # the last round and nothing left unscheduled (the starvation
+        # escalator moves costs only for waiting tasks) => the instance is
+        # bit-identical, the previous optimum stands, stability yields zero
+        # deltas.  This is the incremental scheduler's steady-state cost.
+        if (
+            self.incremental
+            and st.generation == self._last_generation
+            and self._last_unscheduled == 0
+        ):
+            metrics = RoundMetrics(round_index=st.round_index)
+            m = self.last_metrics
+            metrics.num_tasks = m.num_tasks
+            metrics.num_ecs = m.num_ecs
+            metrics.num_machines = m.num_machines
+            metrics.objective = m.objective
+            st.round_index += 1
+            metrics.total_seconds = time.perf_counter() - t0
+            self.last_metrics = metrics
+            return [], metrics
+
         view = st.build_round_view()
         ecs, mt = view.ecs, view.machines
         metrics = RoundMetrics(
@@ -143,6 +197,8 @@ class RoundPlanner:
         )
         if ecs.num_ecs == 0:
             st.round_index += 1
+            self._last_generation = st.generation
+            self._last_unscheduled = 0
             metrics.total_seconds = time.perf_counter() - t0
             self.last_metrics = metrics
             return [], metrics
@@ -150,9 +206,15 @@ class RoundPlanner:
         metrics.num_ecs = ecs.num_ecs
         cm = self.cost_model.build(ecs, mt)
 
-        prices, flows0, unsched0 = self._remap_warm(
+        prices, flows0, unsched0, prev_costs, full_overlap = self._remap_warm(
             list(ecs.ec_ids.tolist()), mt.uuids
         )
+        eps_start = None
+        if self.incremental and full_overlap and prev_costs is not None:
+            eps_start = self._incremental_eps(
+                cm.costs, prev_costs, cm.unsched_cost, self._prev_unsched_cost
+            )
+
         t_solve = time.perf_counter()
         sol = solve_transport(
             cm.costs,
@@ -163,7 +225,19 @@ class RoundPlanner:
             arc_capacity=cm.arc_capacity,
             init_flows=flows0,
             init_unsched=unsched0,
+            eps_start=eps_start,
         )
+        if eps_start is not None and sol.gap_bound == float("inf"):
+            # The warm state was too far off for the short ladder (deep
+            # churn the drift heuristic missed): fall back to a cold solve
+            # rather than committing a repaired/suboptimal assignment.
+            sol = solve_transport(
+                cm.costs,
+                ecs.supply,
+                cm.capacity,
+                cm.unsched_cost,
+                arc_capacity=cm.arc_capacity,
+            )
         metrics.solve_seconds = time.perf_counter() - t_solve
         metrics.objective = sol.objective
         metrics.gap_bound = sol.gap_bound
@@ -175,13 +249,63 @@ class RoundPlanner:
             prices=sol.prices,
             flows=sol.flows,
             unsched=sol.unsched,
+            costs=cm.costs.astype(np.int64),
+            unsched_cost=cm.unsched_cost.astype(np.int64),
         )
 
         deltas = self._assign(sol.flows, view, metrics)
         st.round_index += 1
+        self._last_generation = st.generation
+        # Any task left off a machine — still waiting OR freshly preempted —
+        # moves the starvation escalator next round, so the quiet-round
+        # fast path must not trigger.
+        self._last_unscheduled = metrics.unscheduled + metrics.preempted
         metrics.total_seconds = time.perf_counter() - t0
         self.last_metrics = metrics
         return deltas, metrics
+
+    @staticmethod
+    def _incremental_eps(
+        costs: np.ndarray,
+        prev_costs: np.ndarray,
+        unsched_cost: np.ndarray,
+        prev_unsched_cost: np.ndarray,
+    ):
+        """Epsilon ladder start from the observed cost drift.
+
+        The warm prices are 1-optimal for last round's costs; if every arc
+        (EC->machine and fallback) moved by at most ``d`` raw units and no
+        arc changed admissibility, they are ``(d*scale + 1)``-optimal for
+        this round's costs, so the ladder can start there instead of at
+        the full cost magnitude.  Returns None (= full ladder) on
+        admissibility flips.  ``scale`` must reproduce the solver's own
+        choice (same ``choose_scale`` inputs as ``_host_validate``).
+        """
+        from poseidon_tpu.ops.transport import INF_COST, choose_scale
+
+        now_inadm = costs >= INF_COST
+        prev_inadm = prev_costs >= INF_COST
+        if (now_inadm != prev_inadm).any():
+            return None
+        adm = ~now_inadm
+        drift = 0
+        if adm.any():
+            drift = int(
+                np.abs(costs.astype(np.int64)[adm] - prev_costs[adm]).max()
+            )
+        drift = max(
+            drift,
+            int(
+                np.abs(
+                    unsched_cost.astype(np.int64) - prev_unsched_cost
+                ).max(initial=0)
+            ),
+        )
+        E, M = costs.shape
+        finite_max = int(costs[adm].max()) if adm.any() else 0
+        max_raw = max(finite_max, int(unsched_cost.max(initial=0)), 1)
+        scale = choose_scale(E, M, max_raw)
+        return drift * scale + 1
 
     # -------------------------------------------------------------- assignment
 

@@ -82,6 +82,9 @@ class TaskInfo:
     name: str = ""
     cpu_request: int = 0       # millicores
     ram_request: int = 0       # KB
+    # Net receive bandwidth request (the `networkRequirement` label path,
+    # reference podwatcher.go:467-476 -> ResourceVector.net_rx_bw).
+    net_rx_request: int = 0
     priority: int = 0
     task_type: int = 0
     selectors: Tuple[Selector, ...] = ()
@@ -109,6 +112,7 @@ class TaskInfo:
             self.selectors,
             self.task_type,
             self.priority,
+            self.net_rx_request,
         )
 
 
@@ -118,6 +122,7 @@ class MachineInfo:
     hostname: str = ""
     cpu_capacity: int = 0      # millicores
     ram_capacity: int = 0      # KB
+    net_rx_capacity: int = 0   # ResourceVector.net_rx_bw units
     task_slots: int = DEFAULT_TASK_SLOTS
     labels: Dict[str, str] = field(default_factory=dict)
     healthy: bool = True
@@ -126,6 +131,13 @@ class MachineInfo:
     # Measured utilization from the knowledge base (EMA over AddNodeStats).
     cpu_util: float = 0.0
     mem_util: float = 0.0
+    # Cost-model stat hooks carried on the descriptor: Whare-Map
+    # co-location census (whare_map_stats.proto:23-29) as
+    # (idle, devils, rabbits, sheep, turtles), and CoCo interference
+    # penalties (coco_interference_scores.proto:24-29) as
+    # (devil, rabbit, sheep, turtle).
+    whare_stats: Optional[Tuple[int, int, int, int, int]] = None
+    coco_penalties: Optional[Tuple[int, int, int, int]] = None
     trace_machine_id: int = 0
 
 
@@ -176,10 +188,30 @@ class ClusterState:
         with self._lock:
             existing = self.tasks.get(task.uid)
             if existing is not None:
-                if existing.state in (TaskState.RUNNABLE, TaskState.CREATED):
+                if existing.state in (
+                    TaskState.CREATED,
+                    TaskState.RUNNABLE,
+                    TaskState.ASSIGNED,
+                    TaskState.RUNNING,
+                ):
+                    # Live task re-played (client restart re-list): the
+                    # client wrapper tolerates this reply on submit.
                     return TaskReply.ALREADY_SUBMITTED
+                # Terminal states cannot be re-submitted under this uid.
                 return TaskReply.STATE_NOT_CREATED
-            task.state = TaskState.RUNNABLE
+            # A carried binding (scheduled_to_resource on the descriptor —
+            # restart recovery) is adopted when it resolves to a known
+            # machine; otherwise the task enters as runnable.
+            carried = task.scheduled_to
+            machine_uuid = (
+                self.resource_to_machine.get(carried) if carried else None
+            )
+            if machine_uuid is not None:
+                task.scheduled_to = machine_uuid
+                task.state = TaskState.RUNNING
+            else:
+                task.scheduled_to = None
+                task.state = TaskState.RUNNABLE
             task.submit_round = self.round_index
             self.tasks[task.uid] = task
             self.jobs.setdefault(task.job_id, set()).add(task.uid)
@@ -238,6 +270,7 @@ class ClusterState:
             # (podwatcher.go:362-375 updates request + labels).
             existing.cpu_request = task.cpu_request
             existing.ram_request = task.ram_request
+            existing.net_rx_request = task.net_rx_request
             existing.priority = task.priority
             existing.task_type = task.task_type
             existing.selectors = task.selectors
@@ -304,9 +337,16 @@ class ClusterState:
                 return NodeReply.NOT_FOUND
             existing.cpu_capacity = machine.cpu_capacity
             existing.ram_capacity = machine.ram_capacity
+            existing.net_rx_capacity = machine.net_rx_capacity
             existing.labels = machine.labels
             existing.hostname = machine.hostname or existing.hostname
             existing.healthy = True
+            # Cost-model stat hooks refresh on update (NodeUpdated carries
+            # the full descriptor; absent hooks keep their last value).
+            if machine.whare_stats is not None:
+                existing.whare_stats = machine.whare_stats
+            if machine.coco_penalties is not None:
+                existing.coco_penalties = machine.coco_penalties
             for sub in machine.subtree_uuids:
                 existing.subtree_uuids.add(sub)
                 self.resource_to_machine[sub] = existing.uuid
@@ -359,6 +399,7 @@ class ClusterState:
         initial wave places 100k tasks in one round; per-task locking
         would dominate the round budget.
         """
+        applied = False
         with self._lock:
             for uid, machine_uuid in placements:
                 task = self.tasks.get(uid)
@@ -371,7 +412,11 @@ class ClusterState:
                 else:
                     task.state = TaskState.RUNNING
                     task.wait_rounds = 0
-            self.generation += 1
+                applied = True
+            if applied:
+                # No-op batches leave the generation untouched so quiet
+                # rounds stay recognizable to the incremental fast path.
+                self.generation += 1
 
     def build_round_view(self):
         """Columnar tables for one round, built in a single pass under the
@@ -393,6 +438,12 @@ class ClusterState:
             machines.sort(key=lambda m: m.uuid)
             uuid_to_col = {m.uuid: j for j, m in enumerate(machines)}
 
+            # Resident-task census by interference type and committed net
+            # bandwidth, accumulated in the same single pass (inputs to the
+            # whare/coco/net cost models).
+            census = np.zeros((len(machines), 4), dtype=np.int64)
+            net_used = np.zeros(len(machines), dtype=np.int64)
+
             groups: Dict[int, list] = {}
             reps: Dict[int, TaskInfo] = {}
             for t in self.tasks.values():
@@ -404,12 +455,27 @@ class ClusterState:
                     reps[t.ec_id] = t
                 cur = uuid_to_col.get(t.scheduled_to, -1) \
                     if t.scheduled_to else -1
+                if cur >= 0:
+                    census[cur, t.task_type & 3] += 1
+                    net_used[cur] += t.net_rx_request
                 g.append((t.uid, cur, t.wait_rounds))
+            # Descriptor-carried Whare-Map census (devils, rabbits, sheep,
+            # turtles order folded into SHEEP/RABBIT/DEVIL/TURTLE columns).
+            for j, m in enumerate(machines):
+                if m.whare_stats is not None:
+                    _idle, dev, rab, shp, tur = m.whare_stats
+                    census[j, 0] += shp
+                    census[j, 1] += rab
+                    census[j, 2] += dev
+                    census[j, 3] += tur
 
             ec_ids = sorted(groups)
             member_uids, member_cur, member_wait = [], [], []
             supply = np.empty(len(ec_ids), dtype=np.int32)
             max_wait = np.empty(len(ec_ids), dtype=np.int32)
+            running_by_machine = np.zeros(
+                (len(ec_ids), len(machines)), dtype=np.int32
+            )
             for i, e in enumerate(ec_ids):
                 g = groups[e]
                 k = len(g)
@@ -428,6 +494,11 @@ class ClusterState:
                 member_wait.append(wait_arr[order])
                 supply[i] = k
                 max_wait[i] = wait_arr.max() if k else 0
+                placed = cur_arr[cur_arr >= 0]
+                if placed.size:
+                    running_by_machine[i] = np.bincount(
+                        placed, minlength=len(machines)
+                    )
 
             rep_list = [reps[e] for e in ec_ids]
             ecs = ECTable(
@@ -447,6 +518,10 @@ class ClusterState:
                 ),
                 max_wait_rounds=max_wait,
                 selectors=[r.selectors for r in rep_list],
+                net_rx_request=np.array(
+                    [r.net_rx_request for r in rep_list], dtype=np.int64
+                ),
+                running_by_machine=running_by_machine,
             )
             mt = MachineTable(
                 uuids=[m.uuid for m in machines],
@@ -464,6 +539,18 @@ class ClusterState:
                     [m.task_slots for m in machines], np.int32
                 ),
                 labels=[m.labels for m in machines],
+                net_rx_capacity=np.array(
+                    [m.net_rx_capacity for m in machines], np.int64
+                ),
+                net_rx_used=net_used,
+                type_census=census,
+                coco_penalties=np.array(
+                    [
+                        m.coco_penalties or (0, 0, 0, 0)
+                        for m in machines
+                    ],
+                    dtype=np.int64,
+                ),
             )
             return RoundView(
                 ecs=ecs,

@@ -33,10 +33,14 @@ def task_info_from_proto(td: fpb.TaskDescriptor, job_id: str = "") -> TaskInfo:
         name=td.name,
         cpu_request=int(round(req.cpu_cores)),
         ram_request=int(req.ram_cap),
+        net_rx_request=int(req.net_rx_bw),
         priority=int(td.priority),
         task_type=int(td.task_type),
         selectors=canonical_selectors(td.label_selectors),
         labels=labels_to_dict(td.labels),
+        # Carried binding (restart recovery): the state machine adopts it
+        # when the resource resolves to a known machine.
+        scheduled_to=td.scheduled_to_resource or None,
         trace_job_id=int(td.trace_job_id),
         trace_task_id=int(td.trace_task_id),
     )
@@ -74,10 +78,25 @@ def machine_info_from_proto(
         hostname=rd.friendly_name,
         cpu_capacity=int(round(cap.cpu_cores)),
         ram_capacity=int(cap.ram_cap),
+        net_rx_capacity=int(cap.net_rx_bw),
         labels=labels_to_dict(rd.labels),
         subtree_uuids=subtree,
         trace_machine_id=int(rd.trace_machine_id),
     )
+    # Cost-model stat hooks (whare_map_stats.proto:23-29,
+    # coco_interference_scores.proto:24-29): carried when present.
+    if rd.HasField("whare_map_stats"):
+        wm = rd.whare_map_stats
+        machine.whare_stats = (
+            int(wm.num_idle), int(wm.num_devils), int(wm.num_rabbits),
+            int(wm.num_sheep), int(wm.num_turtles),
+        )
+    if rd.HasField("coco_interference_scores"):
+        co = rd.coco_interference_scores
+        machine.coco_penalties = (
+            int(co.devil_penalty), int(co.rabbit_penalty),
+            int(co.sheep_penalty), int(co.turtle_penalty),
+        )
     if slots > 0:
         machine.task_slots = slots
     return machine

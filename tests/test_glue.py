@@ -241,6 +241,65 @@ def test_deleted_pod_removed(system):
     assert poseidon.shared.uid_for_pod("default/p1") is None
 
 
+def test_restart_recovers_bound_pods():
+    """Full restart of BOTH processes: a fresh service + fresh glue against
+    a cluster that already has bound Running pods.  The re-listed pods
+    carry their binding via scheduled_to_resource and the scheduler adopts
+    the placement instead of treating the machines as empty (regression:
+    bound pods previously fell through the phase machine entirely)."""
+    kube = FakeKube()
+    kube.add_node(Node(name="n1", cpu_capacity=1000, ram_capacity=1 << 24))
+    with FirmamentTPUServer(address="127.0.0.1:0") as server1:
+        cfg = PoseidonConfig(
+            firmament_address=server1.address, scheduling_interval=3600
+        )
+        with Poseidon(kube, config=cfg, run_loop=False) as p1:
+            assert p1.drain_watchers()
+            kube.create_pod(Pod(name="p", cpu_request=900,
+                                ram_request=1 << 18))
+            assert p1.drain_watchers()
+            p1.schedule_once()
+            assert kube.pods["default/p"].phase == "Running"
+
+    # Cold restart: brand-new service (empty state) + brand-new glue.
+    with FirmamentTPUServer(address="127.0.0.1:0") as server2:
+        cfg2 = PoseidonConfig(
+            firmament_address=server2.address, scheduling_interval=3600
+        )
+        with Poseidon(kube, config=cfg2, run_loop=False) as p2:
+            assert p2.drain_watchers()
+            uid = p2.shared.uid_for_pod("default/p")
+            assert uid is not None
+            # The new service adopted the carried binding.
+            task = server2.servicer.state.tasks[uid]
+            assert task.scheduled_to is not None
+            # A second 900m pod must NOT fit: n1's capacity is committed
+            # to the recovered placement.
+            kube.create_pod(Pod(name="q", cpu_request=900,
+                                ram_request=1 << 18))
+            assert p2.drain_watchers()
+            p2.schedule_once()
+            assert kube.pods["default/q"].phase == "Pending"
+            assert kube.pods["default/p"].phase == "Running"
+
+
+def test_finished_pod_stats_not_found(system):
+    """Succeeded pods stop resolving on the stats path (regression: the
+    mapping lived until DELETED and stale stats kept forwarding)."""
+    kube, poseidon, _ = system
+    kube.add_node(Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.create_pod(Pod(name="p", cpu_request=100, ram_request=1 << 18))
+    assert poseidon.drain_watchers()
+    poseidon.schedule_once()
+    kube.set_pod_phase("default/p", "Succeeded")
+    assert poseidon.drain_watchers()
+    assert poseidon.shared.uid_for_pod("default/p") is None
+    # ...but deletion still hands TaskRemoved to the scheduler.
+    kube.delete_pod("default", "p")
+    assert poseidon.drain_watchers()
+    assert poseidon.schedule_once() == []
+
+
 def test_stats_stream_roundtrip(system):
     """Heapster-style stream -> stats server -> firmament knowledge base
     (stats.go:77-159), then the cost model steers away from the hot node."""

@@ -38,10 +38,16 @@ class TestTaskStateMachine:
         assert st.task_submitted(mk_task(1)) == TaskReply.SUBMITTED_OK
         assert st.task_submitted(mk_task(1)) == TaskReply.ALREADY_SUBMITTED
 
-    def test_resubmit_of_running_task_is_state_not_created(self):
+    def test_resubmit_of_running_task_tolerated(self):
+        # A restarted client re-plays its whole world from list+watch,
+        # including bound Running pods: live-task resubmission answers
+        # ALREADY_SUBMITTED (tolerated by the client wrapper); only
+        # terminal states are un-resubmittable under the same uid.
         st = ClusterState()
         st.task_submitted(mk_task(1))
         st.apply_placement(1, "m-0")
+        assert st.task_submitted(mk_task(1)) == TaskReply.ALREADY_SUBMITTED
+        st.task_completed(1)
         assert st.task_submitted(mk_task(1)) == TaskReply.STATE_NOT_CREATED
 
     def test_lifecycle_replies(self):

@@ -1,0 +1,111 @@
+"""Incremental re-solve: quiet-round fast path + warm epsilon ladder.
+
+Correctness bar: an incremental planner must produce the same objective as
+a cold planner on every round of a churn sequence (the incremental path is
+an accelerator, never an approximation).
+"""
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.costmodel import get_cost_model
+from poseidon_tpu.graph.instance import RoundPlanner
+from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+from poseidon_tpu.solver.oracle import transport_objective
+from poseidon_tpu.utils.ids import generate_uuid, task_uid
+
+
+def make_state(num_machines=12, num_tasks=60, seed=0):
+    rng = np.random.default_rng(seed)
+    st = ClusterState()
+    for i in range(num_machines):
+        st.node_added(
+            MachineInfo(
+                uuid=generate_uuid(f"im{i}"),
+                cpu_capacity=int(rng.integers(4000, 16000)),
+                ram_capacity=int(rng.integers(1 << 22, 1 << 25)),
+            )
+        )
+    shapes = [(100, 1 << 18), (500, 1 << 19), (1500, 1 << 20), (250, 1 << 18)]
+    for i in range(num_tasks):
+        cpu, ram = shapes[i % len(shapes)]
+        st.task_submitted(
+            TaskInfo(
+                uid=task_uid("ijob", i), job_id=f"ijob-{i % 4}",
+                cpu_request=cpu, ram_request=ram,
+            )
+        )
+    return st
+
+
+def test_quiet_round_fast_path():
+    st = make_state()
+    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+    deltas, m1 = planner.schedule_round()
+    assert m1.placed == 60 and m1.unscheduled == 0
+    # Nothing changed: the next round must skip the solve entirely.
+    deltas2, m2 = planner.schedule_round()
+    assert deltas2 == []
+    assert m2.solve_seconds == 0.0 and m2.iterations == 0
+    assert m2.objective == m1.objective
+    # A mutation re-arms the solve.
+    st.task_submitted(
+        TaskInfo(uid=task_uid("ijob", 999), job_id="ijob-x",
+                 cpu_request=100, ram_request=1 << 18)
+    )
+    deltas3, m3 = planner.schedule_round()
+    assert len(deltas3) == 1 and m3.iterations > 0
+
+
+def test_incremental_matches_cold_over_churn():
+    st_inc = make_state(seed=3)
+    st_cold = make_state(seed=3)
+    inc = RoundPlanner(st_inc, get_cost_model("cpu_mem"), incremental=True)
+    cold = RoundPlanner(st_cold, get_cost_model("cpu_mem"), incremental=False)
+
+    rng = np.random.default_rng(42)
+    for r in range(6):
+        # Churn drawn once, applied identically to both states: remove a
+        # few tasks, add a few new ones.
+        live = sorted(
+            uid for uid, t in st_inc.tasks.items() if t.state in (2, 4)
+        )
+        doomed = [live[int(k)] for k in
+                  rng.choice(len(live), size=3, replace=False)]
+        fresh = [
+            (task_uid(f"churn-{r}", j), int(rng.integers(1, 20)) * 100)
+            for j in range(3)
+        ]
+        for st in (st_inc, st_cold):
+            for uid in doomed:
+                st.task_removed(uid)
+            for uid, cpu in fresh:
+                st.task_submitted(
+                    TaskInfo(
+                        uid=uid, job_id=f"churn-{r}",
+                        cpu_request=cpu, ram_request=1 << 19,
+                    )
+                )
+        d_inc, m_inc = inc.schedule_round()
+        d_cold, m_cold = cold.schedule_round()
+        assert m_inc.gap_bound == 0.0
+        assert m_inc.objective == m_cold.objective, f"round {r}"
+
+
+def test_incremental_solve_parity_with_oracle():
+    st = make_state(num_machines=8, num_tasks=40, seed=9)
+    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+    planner.schedule_round()
+    # Stats drift changes arc costs without changing admissibility: the
+    # epsilon-start path must still land on the exact optimum.
+    for uuid in list(st.machines)[:4]:
+        st.add_node_stats(uuid, {"cpu_utilization": 0.9, "mem_utilization": 0.8})
+    view = st.build_round_view()
+    cm = planner.cost_model.build(view.ecs, view.machines)
+    _, metrics = planner.schedule_round()
+    want = transport_objective(
+        cm.costs, view.ecs.supply, cm.capacity, cm.unsched_cost,
+        arc_capacity=cm.arc_capacity,
+    )
+    assert metrics.objective == want
+    assert metrics.gap_bound == 0.0
