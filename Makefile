@@ -1,0 +1,42 @@
+# poseidon_tpu build/test plumbing (the analog of the reference's
+# K8s-forked Makefile + hack/ verify scripts, reduced to what this
+# framework actually needs).
+
+PY ?= python
+
+.PHONY: all test test-fast bench protos native verify demo clean
+
+all: protos native test
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -x -q -p no:cacheprovider
+
+bench:
+	$(PY) bench.py
+
+bench-small:
+	$(PY) bench.py --machines 500 --tasks 5000 --ecs 50 --rounds 3 --verbose
+
+protos:
+	$(PY) -m poseidon_tpu.protos.gen
+
+native:
+	$(PY) -c "from poseidon_tpu.native import native_available; \
+	  assert native_available(), 'native build failed'; print('native ok')"
+
+# Entry-point smoke: compile check + multichip dryrun + demo loop.
+verify:
+	$(PY) __graft_entry__.py
+	$(PY) -m poseidon_tpu.protos.gen
+	git diff --exit-code --stat -- poseidon_tpu/protos
+
+demo:
+	$(PY) -m poseidon_tpu.glue.main --demo --scheduling-interval=2 \
+	  --firmament-address=127.0.0.1:19090 &
+
+clean:
+	rm -f poseidon_tpu/native/_graphcore.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

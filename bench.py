@@ -15,10 +15,46 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+
+def _ensure_live_backend() -> None:
+    """Probe the accelerator in a subprocess; fall back to CPU if dead.
+
+    The TPU tunnel can wedge (worker crash leaves every op hanging
+    forever).  A 120s subprocess probe detects that without hanging this
+    process; the fallback re-execs with the accelerator plugin stripped
+    so the benchmark still reports a number (tagged via stderr).
+    """
+    if os.environ.get("POSEIDON_BENCH_NO_PROBE"):
+        return
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax,jax.numpy as jnp;"
+             "print(float((jnp.ones((64,64))@jnp.ones((64,64))).sum()))"],
+            capture_output=True, text=True, timeout=150,
+        )
+        ok = probe.returncode == 0 and "4096" in probe.stdout
+    except subprocess.TimeoutExpired:
+        ok = False
+    if ok:
+        return
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+         if p and "axon" not in p] + [os.path.dirname(os.path.abspath(__file__))]
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    env["POSEIDON_BENCH_NO_PROBE"] = "1"
+    print("# accelerator unreachable; falling back to CPU", file=sys.stderr)
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
 def build_cluster(num_machines: int, num_tasks: int, num_ecs: int, seed=0):
@@ -57,6 +93,7 @@ def build_cluster(num_machines: int, num_tasks: int, num_ecs: int, seed=0):
 
 
 def main(argv=None) -> int:
+    _ensure_live_backend()
     p = argparse.ArgumentParser()
     p.add_argument("--machines", type=int, default=10_000)
     p.add_argument("--tasks", type=int, default=100_000)
@@ -84,14 +121,41 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
 
-    # Steady-state rounds: churn 1% of tasks (complete + resubmit) between
-    # rounds so the incremental path does real work each time.
-    from poseidon_tpu.graph.state import TaskInfo
-    from poseidon_tpu.utils.ids import task_uid
-
-    rng = np.random.default_rng(1)
-    lat = []
+    # Headline metric (the north-star config): a full wave — every task
+    # pending at once — scheduled in one round, 10k machines x 100k pods.
+    # Between measured rounds the whole workload is drained and
+    # resubmitted fresh; compilation is cached from the warm-up.
     uids = list(state.tasks.keys())
+    lat = []
+    for r in range(args.rounds):
+        shapes = {
+            uid: (t.job_id, t.cpu_request, t.ram_request)
+            for uid, t in state.tasks.items()
+        }
+        for uid in uids:
+            state.task_removed(uid)
+        from poseidon_tpu.graph.state import TaskInfo
+
+        for uid, (job, cpu, ram) in shapes.items():
+            state.task_submitted(
+                TaskInfo(uid=uid, job_id=job, cpu_request=cpu,
+                         ram_request=ram)
+            )
+        t0 = time.perf_counter()
+        deltas, metrics = planner.schedule_round()
+        dt = time.perf_counter() - t0
+        lat.append(dt)
+        if args.verbose:
+            print(
+                f"# wave {r}: {dt:.3f}s solve={metrics.solve_seconds:.3f}s "
+                f"placed={metrics.placed} unsched={metrics.unscheduled} "
+                f"obj={metrics.objective} gap={metrics.gap_bound}",
+                file=sys.stderr,
+            )
+
+    # Secondary: steady-state churn rounds (1% of tasks replaced).
+    rng = np.random.default_rng(1)
+    churn_lat = []
     for r in range(args.rounds):
         churn = rng.choice(len(uids), size=max(1, len(uids) // 100),
                            replace=False)
@@ -101,22 +165,26 @@ def main(argv=None) -> int:
             if t is None:
                 continue
             state.task_removed(uid)
-            fresh = TaskInfo(
-                uid=uid, job_id=t.job_id, cpu_request=t.cpu_request,
-                ram_request=t.ram_request,
+            state.task_submitted(
+                TaskInfo(uid=uid, job_id=t.job_id,
+                         cpu_request=t.cpu_request,
+                         ram_request=t.ram_request)
             )
-            state.task_submitted(fresh)
         t0 = time.perf_counter()
         deltas, metrics = planner.schedule_round()
         dt = time.perf_counter() - t0
-        lat.append(dt)
+        churn_lat.append(dt)
         if args.verbose:
             print(
-                f"# round {r}: {dt:.3f}s solve={metrics.solve_seconds:.3f}s "
-                f"deltas={len(deltas)} obj={metrics.objective} "
-                f"gap={metrics.gap_bound}",
+                f"# churn round {r}: {dt:.3f}s "
+                f"solve={metrics.solve_seconds:.3f}s deltas={len(deltas)}",
                 file=sys.stderr,
             )
+    if args.verbose:
+        print(
+            f"# churn p50: {float(np.percentile(churn_lat, 50)):.4f}s",
+            file=sys.stderr,
+        )
 
     p50 = float(np.percentile(lat, 50))
     print(

@@ -46,6 +46,14 @@ class ECTable:
     # its fit check (a running task must not be evicted by its own
     # reservation).
     running_by_machine: Optional[np.ndarray] = None
+    # bool [E] rows that must place all-or-nothing (gang jobs; each gang
+    # is its own EC row by signature construction).
+    is_gang: Optional[np.ndarray] = None
+    # Pod-level (anti-)affinity selectors per EC, and the representative
+    # member's labels (for the self-satisfying first-pod rule).
+    pod_affinity: Optional[List] = None
+    pod_anti_affinity: Optional[List] = None
+    labels: Optional[List[Dict[str, str]]] = None
 
     def net_rx(self) -> np.ndarray:
         if self.net_rx_request is None:
@@ -78,6 +86,12 @@ class MachineTable:
     # penalty vectors (devil, rabbit, sheep, turtle).
     type_census: Optional[np.ndarray] = None       # int64 [M, 4]
     coco_penalties: Optional[np.ndarray] = None    # int64 [M, 4]
+    # Resident-task label aggregates for pod-level affinity: per machine,
+    # (key, value) -> count, key -> count, and total resident tasks.
+    # None when no pending task carries pod selectors (skip the pass).
+    resident_kv: Optional[List[Dict[Tuple[str, str], int]]] = None
+    resident_key: Optional[List[Dict[str, int]]] = None
+    resident_total: Optional[np.ndarray] = None    # int64 [M]
 
     @property
     def num_machines(self) -> int:

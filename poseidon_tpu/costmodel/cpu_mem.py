@@ -30,7 +30,11 @@ from dataclasses import dataclass
 import numpy as np
 
 from poseidon_tpu.costmodel import base
-from poseidon_tpu.costmodel.selectors import selector_admissibility
+from poseidon_tpu.costmodel.selectors import (
+    _matches,
+    pod_selector_admissibility,
+    selector_admissibility,
+)
 from poseidon_tpu.ops.transport import INF_COST
 
 
@@ -87,6 +91,15 @@ class CpuMemCostModel(base.CostModel):
         admissible = fits & selector_admissibility(
             ecs.selectors, machines.labels
         )
+        if (
+            machines.resident_kv is not None
+            and ecs.pod_affinity is not None
+        ):
+            admissible &= pod_selector_admissibility(
+                ecs.pod_affinity, ecs.pod_anti_affinity, ecs.labels,
+                machines.resident_kv, machines.resident_key,
+                machines.resident_total,
+            )
 
         # Per-arc capacity: how many tasks of EC e fit machine m's free
         # resources simultaneously (min over dimensions).  This is the
@@ -103,6 +116,14 @@ class CpuMemCostModel(base.CostModel):
         n_fit = np.minimum(n_cpu, n_ram)
         n_fit = np.where(np.isfinite(n_fit), n_fit, np.iinfo(np.int32).max // 4)
         arc_cap = np.where(admissible, n_fit, 0).astype(np.int32)
+
+        # Anti-affinity to self = spreading: members of such an EC cannot
+        # co-locate, so each machine takes at most one per round (running
+        # residents already exclude their machines via the mask).
+        if ecs.pod_anti_affinity is not None and ecs.labels is not None:
+            for e, sels in enumerate(ecs.pod_anti_affinity):
+                if sels and any(_matches(ecs.labels[e], s) for s in sels):
+                    arc_cap[e] = np.minimum(arc_cap[e], 1)
 
         # Load after placement, per dimension, blending reserved and
         # measured load.

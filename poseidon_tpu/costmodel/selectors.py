@@ -56,6 +56,87 @@ def selector_admissibility(
     return mask
 
 
+def _matches(labels: Dict[str, str], sel: Selector) -> bool:
+    """Does one task's label map satisfy a selector?  K8s matchExpressions
+    semantics: NotIn/NotExists also match objects lacking the key."""
+    stype, key, values = sel
+    if stype == IN_SET:
+        return labels.get(key) in set(values)
+    if stype == NOT_IN_SET:
+        return labels.get(key) not in set(values)
+    if stype == EXISTS_KEY:
+        return key in labels
+    if stype == NOT_EXISTS_KEY:
+        return key not in labels
+    raise ValueError(f"unknown selector type {stype}")
+
+
+def pod_selector_admissibility(
+    ec_pod_affinity,
+    ec_pod_anti_affinity,
+    ec_labels,
+    resident_kv,
+    resident_key,
+    resident_total,
+) -> np.ndarray:
+    """Boolean [E, M] mask from pod-level (anti-)affinity.
+
+    Semantics (K8s podAffinity, machine = topology domain; resolved over
+    rounds against *running* residents):
+
+    - affinity: for every selector, some resident task must satisfy it —
+      unless the EC's own labels satisfy the selector (the first-pod
+      bootstrap rule: a self-selecting group may start anywhere);
+    - anti-affinity: no resident task may satisfy any selector.
+
+    Resident aggregates are per machine: (key,value)->count, key->count,
+    and total resident count, so each selector is O(1) per machine.
+    """
+    E = len(ec_pod_affinity)
+    M = len(resident_kv) if resident_kv is not None else 0
+    mask = np.ones((E, M), dtype=bool)
+    if E == 0 or M == 0 or resident_kv is None:
+        return mask
+
+    def exists_satisfying(m: int, sel: Selector) -> bool:
+        stype, key, values = sel
+        kv = resident_kv[m]
+        kk = resident_key[m]
+        total = int(resident_total[m])
+        if stype == IN_SET:
+            return any(kv.get((key, v), 0) > 0 for v in values)
+        if stype == EXISTS_KEY:
+            return kk.get(key, 0) > 0
+        if stype == NOT_IN_SET:
+            matching = sum(kv.get((key, v), 0) for v in set(values))
+            return total - matching > 0
+        if stype == NOT_EXISTS_KEY:
+            return total - kk.get(key, 0) > 0
+        raise ValueError(f"unknown selector type {stype}")
+
+    cache: Dict[Selector, np.ndarray] = {}
+
+    def per_machine(sel: Selector) -> np.ndarray:
+        got = cache.get(sel)
+        if got is None:
+            got = np.fromiter(
+                (exists_satisfying(m, sel) for m in range(M)),
+                dtype=bool, count=M,
+            )
+            cache[sel] = got
+        return got
+
+    for e in range(E):
+        own = ec_labels[e] if ec_labels is not None else {}
+        for sel in ec_pod_affinity[e]:
+            if _matches(own, sel):
+                continue  # self-satisfying: bootstrap anywhere
+            mask[e] &= per_machine(sel)
+        for sel in ec_pod_anti_affinity[e]:
+            mask[e] &= ~per_machine(sel)
+    return mask
+
+
 def _eval_selector(
     sel: Selector, machine_labels: Sequence[Dict[str, str]]
 ) -> np.ndarray:

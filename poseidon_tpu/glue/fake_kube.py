@@ -35,6 +35,11 @@ class Pod:
     ram_request: int = 0     # KB
     labels: Dict[str, str] = field(default_factory=dict)
     node_selector: Dict[str, str] = field(default_factory=dict)
+    # K8s podAffinity/podAntiAffinity requiredDuringScheduling matchLabels
+    # (machine-level topology): match against labels of pods running on
+    # the candidate node.
+    pod_affinity: Dict[str, str] = field(default_factory=dict)
+    pod_anti_affinity: Dict[str, str] = field(default_factory=dict)
     deleted: bool = False
 
     @property
@@ -117,6 +122,8 @@ class FakeKube(KubeAPI):
         clone = copy.copy(pod)
         clone.labels = dict(pod.labels)
         clone.node_selector = dict(pod.node_selector)
+        clone.pod_affinity = dict(pod.pod_affinity)
+        clone.pod_anti_affinity = dict(pod.pod_anti_affinity)
         return clone
 
     @staticmethod

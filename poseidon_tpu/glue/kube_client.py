@@ -1,0 +1,193 @@
+"""Real-cluster KubeAPI adapter (gated on the ``kubernetes`` client).
+
+Maps the watcher seam (poseidon_tpu.glue.fake_kube.KubeAPI) onto the
+official Kubernetes Python client the way the reference maps it onto
+client-go: list+watch informers for pods/nodes (reference
+pkg/k8sclient/podwatcher.go:81-129, nodewatcher.go:47-81), the
+pods/binding subresource for actuation (k8sclient.go:33-46), and pod
+deletion for preemption (k8sclient.go:49-54).
+
+The ``kubernetes`` package is not part of the baked image; importing this
+module without it raises ImportError with a clear message, and everything
+else in the framework (service, glue against FakeKube, replay, bench)
+works without it.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List
+
+try:
+    from kubernetes import client as k8s_client
+    from kubernetes import config as k8s_config
+    from kubernetes import watch as k8s_watch
+except ImportError as _exc:  # pragma: no cover - gated dependency
+    raise ImportError(
+        "poseidon_tpu.glue.kube_client requires the `kubernetes` package "
+        "(pip install kubernetes); in-process use goes through FakeKube"
+    ) from _exc
+
+from poseidon_tpu.glue.fake_kube import Event, KubeAPI, Node, Pod
+
+_CPU_MULT = {"m": 1, "": 1000}
+
+
+def _parse_cpu(q: str) -> int:
+    """K8s CPU quantity -> millicores (podwatcher.go:135-147 semantics)."""
+    if not q:
+        return 0
+    if q.endswith("m"):
+        return int(q[:-1])
+    return int(float(q) * 1000)
+
+
+_MEM_SUFFIX = {
+    "Ki": 1, "Mi": 1 << 10, "Gi": 1 << 20, "Ti": 1 << 30,
+    "K": 1, "M": 10 ** 3, "G": 10 ** 6, "T": 10 ** 9,
+}
+
+
+def _parse_mem_kb(q: str) -> int:
+    """K8s memory quantity -> KB (the node watcher's unit)."""
+    if not q:
+        return 0
+    for suf, mult in _MEM_SUFFIX.items():
+        if q.endswith(suf):
+            return int(float(q[: -len(suf)]) * mult)
+    return int(q) >> 10  # plain bytes
+
+
+def _pod_from_v1(p) -> Pod:
+    cpu = ram = 0
+    for c in p.spec.containers or []:
+        req = (c.resources and c.resources.requests) or {}
+        cpu += _parse_cpu(req.get("cpu", ""))
+        ram += _parse_mem_kb(req.get("memory", ""))
+    owner = ""
+    if p.metadata.owner_references:
+        owner = p.metadata.owner_references[0].uid
+    affinity = {}
+    anti = {}
+    aff = p.spec.affinity
+    if aff and aff.pod_affinity:
+        for term in (
+            aff.pod_affinity
+            .required_during_scheduling_ignored_during_execution or []
+        ):
+            if term.label_selector and term.label_selector.match_labels:
+                affinity.update(term.label_selector.match_labels)
+    if aff and aff.pod_anti_affinity:
+        for term in (
+            aff.pod_anti_affinity
+            .required_during_scheduling_ignored_during_execution or []
+        ):
+            if term.label_selector and term.label_selector.match_labels:
+                anti.update(term.label_selector.match_labels)
+    return Pod(
+        name=p.metadata.name,
+        namespace=p.metadata.namespace,
+        owner_uid=owner,
+        scheduler_name=p.spec.scheduler_name or "",
+        phase=p.status.phase or "Unknown",
+        node_name=p.spec.node_name or "",
+        cpu_request=cpu,
+        ram_request=ram,
+        labels=dict(p.metadata.labels or {}),
+        node_selector=dict(p.spec.node_selector or {}),
+        pod_affinity=affinity,
+        pod_anti_affinity=anti,
+        deleted=p.metadata.deletion_timestamp is not None,
+    )
+
+
+def _node_from_v1(n) -> Node:
+    cap = n.status.capacity or {}
+    ready = True
+    out_of_disk = False
+    for cond in n.status.conditions or []:
+        if cond.type == "Ready":
+            ready = cond.status == "True"
+        if cond.type == "OutOfDisk":
+            out_of_disk = cond.status == "True"
+    return Node(
+        name=n.metadata.name,
+        cpu_capacity=_parse_cpu(cap.get("cpu", "")),
+        ram_capacity=_parse_mem_kb(cap.get("memory", "")),
+        unschedulable=bool(n.spec.unschedulable),
+        ready=ready,
+        out_of_disk=out_of_disk,
+        labels=dict(n.metadata.labels or {}),
+    )
+
+
+class RealKube(KubeAPI):
+    """KubeAPI over the official client; in- or out-of-cluster config
+    (k8sclient.go:57-62)."""
+
+    def __init__(self, kubeconfig: str = "") -> None:
+        if kubeconfig:
+            k8s_config.load_kube_config(config_file=kubeconfig)
+        else:
+            try:
+                k8s_config.load_incluster_config()
+            except Exception:
+                k8s_config.load_kube_config()
+        self._core = k8s_client.CoreV1Api()
+        self._stop = threading.Event()
+
+    def list_pods(self) -> List[Pod]:
+        out = self._core.list_pod_for_all_namespaces()
+        return [_pod_from_v1(p) for p in out.items]
+
+    def list_nodes(self) -> List[Node]:
+        out = self._core.list_node()
+        return [_node_from_v1(n) for n in out.items]
+
+    def _watch_loop(self, q, list_fn, convert) -> None:
+        w = k8s_watch.Watch()
+        while not self._stop.is_set():
+            try:
+                for ev in w.stream(list_fn, timeout_seconds=30):
+                    q.put((ev["type"], convert(ev["object"])))
+                    if self._stop.is_set():
+                        return
+            except Exception:
+                continue  # resync on watch errors, as informers do
+
+    def watch_pods(self) -> "queue.Queue[Event]":
+        q: "queue.Queue[Event]" = queue.Queue()
+        threading.Thread(
+            target=self._watch_loop,
+            args=(q, self._core.list_pod_for_all_namespaces, _pod_from_v1),
+            daemon=True,
+        ).start()
+        return q
+
+    def watch_nodes(self) -> "queue.Queue[Event]":
+        q: "queue.Queue[Event]" = queue.Queue()
+        threading.Thread(
+            target=self._watch_loop,
+            args=(q, self._core.list_node, _node_from_v1),
+            daemon=True,
+        ).start()
+        return q
+
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
+        # POST pods/{name}/binding (k8sclient.go:33-46).
+        body = k8s_client.V1Binding(
+            metadata=k8s_client.V1ObjectMeta(name=name, namespace=namespace),
+            target=k8s_client.V1ObjectReference(
+                api_version="v1", kind="Node", name=node_name
+            ),
+        )
+        self._core.create_namespaced_pod_binding(
+            name=name, namespace=namespace, body=body, _preload_content=False
+        )
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self._core.delete_namespaced_pod(name=name, namespace=namespace)
+
+    def stop(self) -> None:
+        self._stop.set()

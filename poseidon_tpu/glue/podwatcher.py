@@ -120,6 +120,16 @@ class PodWatcher:
             td.label_selectors.add(
                 type=fpb.LabelSelector.IN_SET, key=k, values=[v]
             )
+        # podAffinity/podAntiAffinity matchLabels -> pod-level selectors
+        # (contract extension; resolved against machine residents).
+        for k, v in sorted(pod.pod_affinity.items()):
+            td.pod_affinity.add(
+                type=fpb.LabelSelector.IN_SET, key=k, values=[v]
+            )
+        for k, v in sorted(pod.pod_anti_affinity.items()):
+            td.pod_anti_affinity.add(
+                type=fpb.LabelSelector.IN_SET, key=k, values=[v]
+            )
         # Already-bound pods (seen on restart re-list) carry their binding
         # so the scheduler state machine can recover the placement
         # (task_desc.proto's scheduled_to_resource field).
@@ -227,6 +237,8 @@ class PodWatcher:
             or old.ram_request != new.ram_request
             or old.labels != new.labels
             or old.node_selector != new.node_selector
+            or old.pod_affinity != new.pod_affinity
+            or old.pod_anti_affinity != new.pod_anti_affinity
         )
 
     def _gc_job(self, pod: Pod) -> None:

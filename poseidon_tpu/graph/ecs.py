@@ -25,6 +25,7 @@ def ec_signature(
     task_type: int,
     priority: int,
     net_rx_request: int = 0,
+    gang_job: str = "",
 ) -> int:
     """64-bit EC id for a task's scheduling-relevant attributes.
 
@@ -32,7 +33,10 @@ def ec_signature(
     request vector's CPU/mem/net dimensions, the selector set (canonically
     sorted), the interference task type (task_desc.proto:45-50) and
     priority.  Tasks differing only in name/labels/owner land in the same
-    EC by design.
+    EC by design — EXCEPT gang members: a gang job contributes its job id,
+    giving each gang its own EC row so all-or-nothing placement is a
+    per-row property of the flow solution (the flow-gadget analog of
+    Firmament's job-level min-flow requirements).
     """
     h = fnv64a("ec")
     h = hash_combine(h, int(cpu_request))
@@ -40,6 +44,8 @@ def ec_signature(
     h = hash_combine(h, int(net_rx_request))
     h = hash_combine(h, int(task_type))
     h = hash_combine(h, int(priority))
+    if gang_job:
+        h = hash_combine(h, "gang:" + gang_job)
     for stype, key, values in sorted(selectors):
         h = hash_combine(h, int(stype))
         h = hash_combine(h, key)

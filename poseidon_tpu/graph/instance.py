@@ -83,6 +83,100 @@ class _WarmState:
     unsched_cost: Optional[np.ndarray] = None
 
 
+def _remap_warm_state(w: _WarmState, ec_ids: List[int],
+                      machine_uuids: List[str]):
+    """Carry one band's prices/flows/costs from the previous round into
+    this round's index space (ECs/machines may have churned).
+
+    Returns ``(prices, flows, unsched, prev_costs, prev_unsched_cost,
+    full_overlap)``; ``prev_costs``/``prev_unsched_cost`` cells with no
+    predecessor are -1, and ``full_overlap`` is True iff every current EC
+    and machine existed last round (the precondition for the incremental
+    epsilon start).
+    """
+    if w.prices is None:
+        return None, None, None, None, None, False
+    E, M = len(ec_ids), len(machine_uuids)
+    prev_e = {e: i for i, e in enumerate(w.ec_ids)}
+    prev_m = {u: i for i, u in enumerate(w.machine_uuids)}
+    prices = np.zeros(E + M + 1, dtype=np.int32)
+    prices[E + M] = w.prices[len(w.ec_ids) + len(w.machine_uuids)]
+    flows = np.zeros((E, M), dtype=np.int32)
+    unsched = np.zeros(E, dtype=np.int32)
+    prev_costs = np.full((E, M), -1, dtype=np.int64)
+    prev_unsched_cost = np.full(E, -1, dtype=np.int64)
+    # Vectorized gather of the surviving rows/columns (this runs every
+    # round; a Python E*M loop would dwarf the solve at scale).
+    e_idx = np.array([prev_e.get(e, -1) for e in ec_ids], dtype=np.int64)
+    m_idx = np.array(
+        [prev_m.get(u, -1) for u in machine_uuids], dtype=np.int64
+    )
+    ke_new = np.nonzero(e_idx >= 0)[0]
+    km_new = np.nonzero(m_idx >= 0)[0]
+    ke_old = e_idx[ke_new]
+    km_old = m_idx[km_new]
+    prices[ke_new] = w.prices[ke_old]
+    prices[E + km_new] = w.prices[len(w.ec_ids) + km_old]
+    if w.unsched is not None:
+        unsched[ke_new] = w.unsched[ke_old]
+    if w.flows is not None and ke_new.size and km_new.size:
+        flows[np.ix_(ke_new, km_new)] = w.flows[np.ix_(ke_old, km_old)]
+    if w.costs is not None and ke_new.size and km_new.size:
+        prev_costs[np.ix_(ke_new, km_new)] = w.costs[np.ix_(ke_old, km_old)]
+    if w.unsched_cost is not None and ke_new.size:
+        prev_unsched_cost[ke_new] = w.unsched_cost[ke_old]
+    full_overlap = ke_new.size == E and km_new.size == M
+    return prices, flows, unsched, prev_costs, prev_unsched_cost, full_overlap
+
+
+def _slice_ecs(ecs, idx: np.ndarray):
+    """Row-sliced ECTable view for one band."""
+    from poseidon_tpu.costmodel.base import ECTable
+
+    rows = idx.tolist()
+    return ECTable(
+        ec_ids=ecs.ec_ids[idx],
+        cpu_request=ecs.cpu_request[idx],
+        ram_request=ecs.ram_request[idx],
+        supply=ecs.supply[idx],
+        priority=ecs.priority[idx],
+        task_type=ecs.task_type[idx],
+        max_wait_rounds=ecs.max_wait_rounds[idx],
+        selectors=[ecs.selectors[i] for i in rows],
+        net_rx_request=(
+            ecs.net_rx_request[idx]
+            if ecs.net_rx_request is not None else None
+        ),
+        running_by_machine=(
+            ecs.running_by_machine[idx]
+            if ecs.running_by_machine is not None else None
+        ),
+        is_gang=ecs.is_gang[idx] if ecs.is_gang is not None else None,
+        pod_affinity=(
+            [ecs.pod_affinity[i] for i in rows]
+            if ecs.pod_affinity is not None else None
+        ),
+        pod_anti_affinity=(
+            [ecs.pod_anti_affinity[i] for i in rows]
+            if ecs.pod_anti_affinity is not None else None
+        ),
+        labels=(
+            [ecs.labels[i] for i in rows]
+            if ecs.labels is not None else None
+        ),
+    )
+
+
+def _with_usage(mt, cpu_used, ram_used, net_used, slots_free):
+    """MachineTable with this band's committed-resource view."""
+    from dataclasses import replace
+
+    return replace(
+        mt, cpu_used=cpu_used, ram_used=ram_used,
+        net_rx_used=net_used, slots_free=slots_free,
+    )
+
+
 class RoundPlanner:
     """Owns the solve path; one instance per service process."""
 
@@ -93,73 +187,28 @@ class RoundPlanner:
         *,
         preemption: bool = True,
         incremental: bool = True,
+        reschedule_running: bool = False,
     ) -> None:
         self.state = state
         self.cost_model = cost_model
         self.preemption = preemption
+        # reschedule_running=False (default, reference semantics): RUNNING
+        # tasks hold reservations and stay put; each round solves only the
+        # pending work — stable placements, small solves.  True re-enters
+        # the whole workload every round for global re-optimization
+        # (migrations/preemptions from the solver); at cluster scale this
+        # trades round latency and churn for placement optimality.
+        self.reschedule_running = reschedule_running
         # Incremental re-solve (the Flowlessly analog, SURVEY.md section 7
         # step 7): quiet rounds skip the solve outright, and low-churn
         # rounds start the epsilon ladder at the observed cost drift
         # instead of the full cost magnitude.
         self.incremental = incremental
-        self._warm = _WarmState()
-        self._prev_unsched_cost: Optional[np.ndarray] = None
+        # Warm-start frames, one per size band (see _solve_banded).
+        self._warm_bands: Dict[int, _WarmState] = {}
         self._last_generation = -1
         self._last_unscheduled = 1  # force a solve on the first round
         self.last_metrics = RoundMetrics()
-
-    # ------------------------------------------------------------- warm start
-
-    def _remap_warm(
-        self, ec_ids: List[int], machine_uuids: List[str]
-    ) -> Tuple[
-        Optional[np.ndarray], Optional[np.ndarray], Optional[np.ndarray],
-        Optional[np.ndarray], bool,
-    ]:
-        """Carry prices/flows/costs from the previous round into this
-        round's index space (ECs/machines may have churned).
-
-        Returns ``(prices, flows, unsched, prev_costs, full_overlap)``;
-        ``prev_costs`` cells with no predecessor are -1, and
-        ``full_overlap`` is True iff every current EC and machine existed
-        last round (the precondition for the incremental epsilon start).
-        """
-        w = self._warm
-        if w.prices is None:
-            return None, None, None, None, False
-        E, M = len(ec_ids), len(machine_uuids)
-        prev_e = {e: i for i, e in enumerate(w.ec_ids)}
-        prev_m = {u: i for i, u in enumerate(w.machine_uuids)}
-        prices = np.zeros(E + M + 1, dtype=np.int32)
-        prices[E + M] = w.prices[len(w.ec_ids) + len(w.machine_uuids)]
-        flows = np.zeros((E, M), dtype=np.int32)
-        unsched = np.zeros(E, dtype=np.int32)
-        prev_costs = np.full((E, M), -1, dtype=np.int64)
-        # Vectorized gather of the surviving rows/columns (this runs every
-        # round; a Python E*M loop would dwarf the solve at scale).
-        e_idx = np.array([prev_e.get(e, -1) for e in ec_ids], dtype=np.int64)
-        m_idx = np.array(
-            [prev_m.get(u, -1) for u in machine_uuids], dtype=np.int64
-        )
-        ke_new = np.nonzero(e_idx >= 0)[0]
-        km_new = np.nonzero(m_idx >= 0)[0]
-        ke_old = e_idx[ke_new]
-        km_old = m_idx[km_new]
-        prices[ke_new] = w.prices[ke_old]
-        prices[E + km_new] = w.prices[len(w.ec_ids) + km_old]
-        if w.unsched is not None:
-            unsched[ke_new] = w.unsched[ke_old]
-        if w.flows is not None and ke_new.size and km_new.size:
-            flows[np.ix_(ke_new, km_new)] = w.flows[np.ix_(ke_old, km_old)]
-        if w.costs is not None and ke_new.size and km_new.size:
-            prev_costs[np.ix_(ke_new, km_new)] = w.costs[
-                np.ix_(ke_old, km_old)
-            ]
-        self._prev_unsched_cost = np.full(E, -1, dtype=np.int64)
-        if w.unsched_cost is not None and ke_new.size:
-            self._prev_unsched_cost[ke_new] = w.unsched_cost[ke_old]
-        full_overlap = ke_new.size == E and km_new.size == M
-        return prices, flows, unsched, prev_costs, full_overlap
 
     # ------------------------------------------------------------------ round
 
@@ -188,7 +237,7 @@ class RoundPlanner:
             self.last_metrics = metrics
             return [], metrics
 
-        view = st.build_round_view()
+        view = st.build_round_view(include_running=self.reschedule_running)
         ecs, mt = view.ecs, view.machines
         metrics = RoundMetrics(
             round_index=st.round_index,
@@ -204,56 +253,12 @@ class RoundPlanner:
             return [], metrics
 
         metrics.num_ecs = ecs.num_ecs
-        cm = self.cost_model.build(ecs, mt)
-
-        prices, flows0, unsched0, prev_costs, full_overlap = self._remap_warm(
-            list(ecs.ec_ids.tolist()), mt.uuids
-        )
-        eps_start = None
-        if self.incremental and full_overlap and prev_costs is not None:
-            eps_start = self._incremental_eps(
-                cm.costs, prev_costs, cm.unsched_cost, self._prev_unsched_cost
-            )
 
         t_solve = time.perf_counter()
-        sol = solve_transport(
-            cm.costs,
-            ecs.supply,
-            cm.capacity,
-            cm.unsched_cost,
-            prices,
-            arc_capacity=cm.arc_capacity,
-            init_flows=flows0,
-            init_unsched=unsched0,
-            eps_start=eps_start,
-        )
-        if eps_start is not None and sol.gap_bound == float("inf"):
-            # The warm state was too far off for the short ladder (deep
-            # churn the drift heuristic missed): fall back to a cold solve
-            # rather than committing a repaired/suboptimal assignment.
-            sol = solve_transport(
-                cm.costs,
-                ecs.supply,
-                cm.capacity,
-                cm.unsched_cost,
-                arc_capacity=cm.arc_capacity,
-            )
+        flows = self._solve_banded(ecs, mt, metrics)
         metrics.solve_seconds = time.perf_counter() - t_solve
-        metrics.objective = sol.objective
-        metrics.gap_bound = sol.gap_bound
-        metrics.iterations = sol.iterations
 
-        self._warm = _WarmState(
-            ec_ids=list(ecs.ec_ids.tolist()),
-            machine_uuids=list(mt.uuids),
-            prices=sol.prices,
-            flows=sol.flows,
-            unsched=sol.unsched,
-            costs=cm.costs.astype(np.int64),
-            unsched_cost=cm.unsched_cost.astype(np.int64),
-        )
-
-        deltas = self._assign(sol.flows, view, metrics)
+        deltas = self._assign(flows, view, metrics)
         st.round_index += 1
         self._last_generation = st.generation
         # Any task left off a machine — still waiting OR freshly preempted —
@@ -264,34 +269,281 @@ class RoundPlanner:
         self.last_metrics = metrics
         return deltas, metrics
 
+    # Size-band ladder: rows whose dominant resource fraction falls within
+    # one factor-of-BAND_BASE band solve together; bands go largest-first.
+    BAND_BASE = 4.0
+    NUM_BANDS = 8
+
+    def _band_of_rows(self, ecs, mt) -> np.ndarray:
+        """Band index per EC row from the dominant request/capacity
+        fraction (0 = largest tasks)."""
+        cap_cpu = float(max(int(mt.cpu_capacity.max(initial=1)), 1))
+        cap_ram = float(max(int(mt.ram_capacity.max(initial=1)), 1))
+        frac = np.maximum(
+            ecs.cpu_request.astype(np.float64) / cap_cpu,
+            ecs.ram_request.astype(np.float64) / cap_ram,
+        )
+        frac = np.clip(frac, 1e-12, 1.0)
+        band = np.floor(-np.log(frac) / np.log(self.BAND_BASE))
+        return np.clip(band, 0, self.NUM_BANDS - 1).astype(np.int64)
+
+    def _solve_banded(self, ecs, mt, metrics) -> np.ndarray:
+        """The round's solve: size-banded transportation with committed
+        resources flowing between bands.
+
+        Why bands: the transportation relaxation's machine capacity is a
+        *task count*, so heterogeneous ECs can jointly oversubscribe a
+        machine's CPU/RAM/NIC.  Within a band all requests are within a
+        factor of BAND_BASE, so a per-machine column capacity of
+        ``floor(free_dim / max_request_dim_in_band)`` (min over
+        dimensions) makes ANY feasible flow resource-safe by construction
+        — no iterative repair, no over-commit, ever.  Bands run
+        largest-first, each consuming the resources the previous ones
+        committed (big tasks get first pick; small ones pack the gaps).
+        Gang atomicity (all-or-nothing rows) is enforced within each
+        band's solve by forbidding partially-placed gang rows and
+        re-solving warm.
+
+        Replaces (TPU-native): the external solver dispatch of the
+        reference scheduler (deploy/firmament-deployment.yaml:29-31);
+        cost parity vs the exact oracle holds per band.
+        """
+        E, M = ecs.num_ecs, mt.num_machines
+        flows_full = np.zeros((E, M), dtype=np.int32)
+        if M == 0:
+            metrics.objective = int(
+                (self.cost_model.build(ecs, mt).unsched_cost.astype(np.int64)
+                 * ecs.supply.astype(np.int64)).sum()
+            )
+            return flows_full
+
+        bands = self._band_of_rows(ecs, mt)
+        committed_cpu = mt.cpu_used.astype(np.int64).copy()
+        committed_ram = mt.ram_used.astype(np.int64).copy()
+        committed_net = (
+            mt.net_rx_used.astype(np.int64).copy()
+            if mt.net_rx_used is not None
+            else np.zeros(M, dtype=np.int64)
+        )
+        committed_slots = np.zeros(M, dtype=np.int64)
+        base_slots = mt.slots_free.astype(np.int64)
+
+        objective = 0
+        gap = 0.0
+        iters = 0
+        for band in sorted(set(bands.tolist())):
+            idx = np.nonzero(bands == band)[0]
+            ecs_b = _slice_ecs(ecs, idx)
+            mt_b = _with_usage(
+                mt, committed_cpu, committed_ram, committed_net,
+                np.maximum(base_slots - committed_slots, 0).astype(np.int32),
+            )
+            cm = self.cost_model.build(ecs_b, mt_b)
+
+            # Resource-safe column capacity (min over dimensions).  Rows
+            # whose request exceeds every machine outright can never carry
+            # flow (per-arc fit already zeroes them), so they must not
+            # poison the band's max-request denominator.
+            col_cap = cm.capacity.astype(np.int64)
+            for req, cap_arr, used in (
+                (ecs_b.cpu_request, mt.cpu_capacity, committed_cpu),
+                (ecs_b.ram_request, mt.ram_capacity, committed_ram),
+            ):
+                placeable = req <= int(cap_arr.max(initial=0))
+                mx = int(req[placeable].max(initial=0))
+                if mx > 0:
+                    free = np.maximum(
+                        cap_arr.astype(np.int64) - used, 0
+                    )
+                    col_cap = np.minimum(col_cap, free // mx)
+            net_req = ecs_b.net_rx()
+            if mt.net_rx_capacity is not None:
+                raw = mt.net_rx_capacity.astype(np.int64)
+                placeable = net_req <= int(raw.max(initial=0))
+                mx_net = int(net_req[placeable].max(initial=0))
+                if mx_net > 0:
+                    free = np.maximum(raw - committed_net, 0)
+                    col_cap = np.where(
+                        raw > 0,
+                        np.minimum(col_cap, free // mx_net),
+                        col_cap,
+                    )
+            col_cap = np.clip(col_cap, 0, None).astype(np.int32)
+
+            sol = self._solve_band(band, ecs_b, cm, col_cap, mt.uuids)
+            objective += sol.objective
+            gap = max(gap, sol.gap_bound)
+            iters += sol.iterations
+            flows_full[idx] = sol.flows
+
+            fl = sol.flows.astype(np.int64)
+            committed_cpu += fl.T @ ecs_b.cpu_request.astype(np.int64)
+            committed_ram += fl.T @ ecs_b.ram_request.astype(np.int64)
+            committed_net += fl.T @ net_req.astype(np.int64)
+            committed_slots += fl.sum(axis=0)
+
+        metrics.objective = objective
+        metrics.gap_bound = gap
+        metrics.iterations = iters
+        return flows_full
+
+    def _solve_band(self, band, ecs_b, cm, col_cap, machine_uuids):
+        """One band's solve: warm-started (per-band frames are stable
+        across rounds because the band of an EC is a function of its
+        size), drift-derived epsilon ladder, gang atomicity repair."""
+        from poseidon_tpu.ops.transport import INF_COST
+
+        warm = self._warm_bands.get(band, _WarmState())
+        (prices, flows0, unsched0, prev_costs, prev_unsched,
+         full_overlap) = _remap_warm_state(
+            warm, list(ecs_b.ec_ids.tolist()), list(machine_uuids)
+        )
+        eps_start = None
+        if self.incremental and full_overlap and prev_costs is not None:
+            eps_start = self._incremental_eps(
+                cm.costs, prev_costs, cm.unsched_cost, prev_unsched, prices
+            )
+
+        def run(costs, eps, p=None, f=None, u=None):
+            return solve_transport(
+                costs, ecs_b.supply, col_cap, cm.unsched_cost, p,
+                arc_capacity=cm.arc_capacity, init_flows=f,
+                init_unsched=u, eps_start=eps,
+            )
+
+        sol = run(cm.costs, eps_start, prices, flows0, unsched0)
+        if eps_start is not None and sol.gap_bound == float("inf"):
+            # Deep churn the drift heuristic missed: cold full ladder.
+            sol = run(cm.costs, None)
+
+        # Gang atomicity: forbid partially-placed gang rows, re-solve warm
+        # (each pass permanently forbids >= 1 row, so this terminates).
+        effective_costs = cm.costs
+        if ecs_b.is_gang is not None and ecs_b.is_gang.any():
+            for _ in range(int(ecs_b.is_gang.sum())):
+                placed = sol.flows.sum(axis=1)
+                partial = (
+                    ecs_b.is_gang & (placed > 0) & (placed < ecs_b.supply)
+                )
+                if not partial.any():
+                    break
+                if effective_costs is cm.costs:
+                    effective_costs = cm.costs.copy()
+                effective_costs[partial] = INF_COST
+                sol = run(
+                    effective_costs, 1, sol.prices, sol.flows, sol.unsched
+                )
+                if sol.gap_bound == float("inf"):
+                    sol = run(effective_costs, None)
+
+        self._warm_bands[band] = _WarmState(
+            ec_ids=list(ecs_b.ec_ids.tolist()),
+            machine_uuids=list(machine_uuids),
+            prices=sol.prices,
+            flows=sol.flows,
+            unsched=sol.unsched,
+            # The saved frame must be the costs the final prices are
+            # optimal for (gang repair may have forbidden rows).
+            costs=effective_costs.astype(np.int64),
+            unsched_cost=cm.unsched_cost.astype(np.int64),
+        )
+        return sol
+
+    @staticmethod
+    def _capacity_cuts(flows, ecs, mt, costs):
+        """Per-machine resource check -> arc-capacity clamps.
+
+        For every machine whose assigned units exceed CPU/RAM (or NIC,
+        when accounted) capacity, keep units along the cheapest arcs
+        first and clamp each arc's capacity to the kept count.  Returns
+        {(ec_row, machine_col): kept_units}; empty when feasible.
+        """
+        cpu_req = ecs.cpu_request.astype(np.int64)
+        ram_req = ecs.ram_request.astype(np.int64)
+        net_req = ecs.net_rx().astype(np.int64)
+        fl = flows.astype(np.int64)
+        cpu_load = fl.T @ cpu_req
+        ram_load = fl.T @ ram_req
+        # Free capacity: reservations held by running tasks (reservation
+        # mode) are not available to this round's batch.
+        cpu_cap = (mt.cpu_capacity - mt.cpu_used).astype(np.int64)
+        ram_cap = (mt.ram_capacity - mt.ram_used).astype(np.int64)
+        over = (cpu_load > cpu_cap) | (ram_load > ram_cap)
+        net_accounted = None
+        net_free = None
+        if mt.net_rx_capacity is not None and net_req.any():
+            raw_cap = mt.net_rx_capacity.astype(np.int64)
+            used = (
+                mt.net_rx_used.astype(np.int64)
+                if mt.net_rx_used is not None
+                else np.zeros_like(raw_cap)
+            )
+            net_accounted = raw_cap > 0
+            net_free = np.maximum(raw_cap - used, 0)
+            net_load = fl.T @ net_req
+            over |= net_accounted & (net_load > net_free)
+        cuts = {}
+        for m in np.nonzero(over)[0]:
+            rows = np.nonzero(flows[:, m])[0]
+            rows = rows[np.argsort(costs[rows, m], kind="stable")]
+            cpu_left, ram_left = int(cpu_cap[m]), int(ram_cap[m])
+            check_net = net_accounted is not None and bool(net_accounted[m])
+            net_left = int(net_free[m]) if check_net else 0
+            for e in rows.tolist():
+                want = int(flows[e, m])
+                fit = want
+                if cpu_req[e] > 0:
+                    fit = min(fit, cpu_left // int(cpu_req[e]))
+                if ram_req[e] > 0:
+                    fit = min(fit, ram_left // int(ram_req[e]))
+                if check_net and net_req[e] > 0:
+                    fit = min(fit, net_left // int(net_req[e]))
+                if fit < want:
+                    cuts[(e, int(m))] = fit
+                cpu_left -= fit * int(cpu_req[e])
+                ram_left -= fit * int(ram_req[e])
+                if check_net:
+                    net_left -= fit * int(net_req[e])
+        return cuts
+
     @staticmethod
     def _incremental_eps(
         costs: np.ndarray,
         prev_costs: np.ndarray,
         unsched_cost: np.ndarray,
         prev_unsched_cost: np.ndarray,
+        prices: Optional[np.ndarray],
     ):
-        """Epsilon ladder start from the observed cost drift.
+        """Epsilon ladder start from the observed cost change under the
+        carried prices.
 
-        The warm prices are 1-optimal for last round's costs; if every arc
-        (EC->machine and fallback) moved by at most ``d`` raw units and no
-        arc changed admissibility, they are ``(d*scale + 1)``-optimal for
-        this round's costs, so the ladder can start there instead of at
-        the full cost magnitude.  Returns None (= full ladder) on
-        admissibility flips.  ``scale`` must reproduce the solver's own
-        choice (same ``choose_scale`` inputs as ``_host_validate``).
+        The warm prices are 1-optimal for last round's costs, so this
+        round they are ``eps``-optimal for the smallest ``eps`` covering
+        (a) the per-arc cost drift on arcs that kept their admissibility,
+        and (b) the (possibly deeply negative) reduced cost of arcs that
+        BECAME admissible this round — e.g. capacity freed by completed
+        tasks re-opening fit.  Arcs that became inadmissible need nothing:
+        their carried flow is dropped at solve init and re-routed.
+        ``scale`` must reproduce the solver's own choice
+        (``_host_validate``: padded rows, quantized cost bound).
         """
-        from poseidon_tpu.ops.transport import INF_COST, choose_scale
+        from poseidon_tpu.ops.transport import (
+            COST_CAP,
+            INF_COST,
+            choose_scale,
+        )
 
         now_inadm = costs >= INF_COST
         prev_inadm = prev_costs >= INF_COST
-        if (now_inadm != prev_inadm).any():
-            return None
-        adm = ~now_inadm
+        adm_both = ~now_inadm & ~prev_inadm
+        fresh = ~now_inadm & prev_inadm          # newly admissible arcs
         drift = 0
-        if adm.any():
+        if adm_both.any():
             drift = int(
-                np.abs(costs.astype(np.int64)[adm] - prev_costs[adm]).max()
+                np.abs(
+                    costs.astype(np.int64)[adm_both]
+                    - prev_costs[adm_both]
+                ).max()
             )
         drift = max(
             drift,
@@ -302,10 +554,28 @@ class RoundPlanner:
             ),
         )
         E, M = costs.shape
-        finite_max = int(costs[adm].max()) if adm.any() else 0
+        # Reproduce the solver's scale derivation exactly (it pads rows to
+        # a power of two and quantizes the cost bound; _host_validate).
+        e_pad = max(8, 1 << (E - 1).bit_length())
+        finite_max = int(costs[~now_inadm].max()) if (~now_inadm).any() else 0
         max_raw = max(finite_max, int(unsched_cost.max(initial=0)), 1)
-        scale = choose_scale(E, M, max_raw)
-        return drift * scale + 1
+        max_raw_q = 1 << (max_raw - 1).bit_length() if max_raw > 1 else 1
+        max_raw_q = min(max_raw_q, COST_CAP)
+        scale = choose_scale(e_pad, M, max_raw_q)
+
+        eps = drift * scale + 1
+        if fresh.any():
+            if prices is None:
+                return None
+            pe = prices[:E].astype(np.int64)
+            pm = prices[E : E + M].astype(np.int64)
+            rc = (
+                costs.astype(np.int64) * scale
+                + pe[:, None] - pm[None, :]
+            )
+            worst = int((-rc[fresh]).max(initial=0))
+            eps = max(eps, worst + 1)
+        return eps
 
     # -------------------------------------------------------------- assignment
 

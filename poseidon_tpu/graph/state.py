@@ -88,12 +88,20 @@ class TaskInfo:
     priority: int = 0
     task_type: int = 0
     selectors: Tuple[Selector, ...] = ()
+    # Pod-level (anti-)affinity: selectors evaluated against the labels of
+    # tasks running on each machine (K8s podAffinity semantics, resolved
+    # across rounds; BASELINE config 3).
+    pod_affinity: Tuple[Selector, ...] = ()
+    pod_anti_affinity: Tuple[Selector, ...] = ()
     labels: Dict[str, str] = field(default_factory=dict)
     state: TaskState = TaskState.RUNNABLE
     # Machine uuid this task is currently placed on (None = unscheduled).
     scheduled_to: Optional[str] = None
     submit_round: int = 0
     wait_rounds: int = 0
+    # Gang scheduling: all of this job's tasks place atomically or not at
+    # all (the `gangScheduling` pod label path; BASELINE config 4).
+    gang: bool = False
     # Cluster-trace replay hooks (task_desc.proto:98-99).
     trace_job_id: int = 0
     trace_task_id: int = 0
@@ -109,10 +117,19 @@ class TaskInfo:
         return ec_signature(
             self.cpu_request,
             self.ram_request,
-            self.selectors,
+            self.selectors + (
+                # Pod-level selectors partition ECs the same way node
+                # selectors do (different constraints => different row);
+                # the key prefix keeps them distinct from node selectors.
+                tuple((st, "pod-aff:" + k, v)
+                      for st, k, v in self.pod_affinity)
+                + tuple((st, "pod-anti:" + k, v)
+                        for st, k, v in self.pod_anti_affinity)
+            ),
             self.task_type,
             self.priority,
             self.net_rx_request,
+            gang_job=self.job_id if self.gang else "",
         )
 
 
@@ -166,10 +183,27 @@ class RoundView:
 
 class ClusterState:
     """The mutable cluster model; thread-safe (the gRPC server is
-    multi-threaded, matching the reference's concurrent watcher RPCs)."""
+    multi-threaded, matching the reference's concurrent watcher RPCs).
 
-    def __init__(self) -> None:
+    The numeric hot path — the O(N) per-round aggregation over every task
+    — is mirrored into the native C++ graph core (poseidon_tpu/native)
+    when available; every mutator updates the mirror under the same lock,
+    and ``build_round_view`` reads the columnar view from it.  Falls back
+    to the pure-Python pass when the toolchain is absent or
+    ``use_native=False``.
+    """
+
+    def __init__(self, use_native: bool = True) -> None:
         self._lock = threading.RLock()
+        self._native = None
+        self._machine_key: Dict[str, int] = {}  # uuid -> native key
+        if use_native:
+            try:
+                from poseidon_tpu.native import NativeGraphCore
+
+                self._native = NativeGraphCore()
+            except Exception:
+                self._native = None
         self.tasks: Dict[int, TaskInfo] = {}
         self.jobs: Dict[str, Set[int]] = {}
         self.machines: Dict[str, MachineInfo] = {}
@@ -181,6 +215,19 @@ class ClusterState:
         # Monotonic generation, bumped on every mutation; lets the planner
         # skip rebuild work on quiet rounds.
         self.generation = 0
+        # Live count of tasks carrying pod-level (anti-)affinity: the
+        # per-round resident-label pass is skipped entirely when zero.
+        self._pod_selector_tasks = 0
+
+    def _nkey(self, uuid: str) -> int:
+        """Native machine key for a uuid (minted once; never 0)."""
+        key = self._machine_key.get(uuid)
+        if key is None:
+            from poseidon_tpu.utils.ids import fnv64a
+
+            key = fnv64a(uuid) or 1
+            self._machine_key[uuid] = key
+        return key
 
     # ------------------------------------------------------------------ tasks
 
@@ -215,6 +262,17 @@ class ClusterState:
             task.submit_round = self.round_index
             self.tasks[task.uid] = task
             self.jobs.setdefault(task.job_id, set()).add(task.uid)
+            if task.pod_affinity or task.pod_anti_affinity:
+                self._pod_selector_tasks += 1
+            if self._native is not None:
+                self._native.task_submit(
+                    task.uid, task.ec_id, task.cpu_request,
+                    task.ram_request, task.net_rx_request, task.task_type,
+                )
+                if task.scheduled_to is not None:
+                    self._native.task_place(
+                        task.uid, self._nkey(task.scheduled_to)
+                    )
             self.generation += 1
             return TaskReply.SUBMITTED_OK
 
@@ -224,6 +282,8 @@ class ClusterState:
             return None
         task.state = state
         task.scheduled_to = None
+        if self._native is not None:
+            self._native.task_set_state(uid, int(state))
         self.generation += 1
         return task
 
@@ -244,6 +304,8 @@ class ClusterState:
             # the failed task itself is later TaskRemoved.
             task.state = TaskState.FAILED
             task.scheduled_to = None
+            if self._native is not None:
+                self._native.task_set_state(uid, int(TaskState.FAILED))
             self.generation += 1
             return TaskReply.FAILED_OK
 
@@ -252,6 +314,10 @@ class ClusterState:
             task = self.tasks.pop(uid, None)
             if task is None:
                 return TaskReply.NOT_FOUND
+            if task.pod_affinity or task.pod_anti_affinity:
+                self._pod_selector_tasks -= 1
+            if self._native is not None:
+                self._native.task_remove(uid)
             members = self.jobs.get(task.job_id)
             if members is not None:
                 members.discard(uid)
@@ -273,9 +339,20 @@ class ClusterState:
             existing.net_rx_request = task.net_rx_request
             existing.priority = task.priority
             existing.task_type = task.task_type
+            had = bool(existing.pod_affinity or existing.pod_anti_affinity)
             existing.selectors = task.selectors
+            existing.pod_affinity = task.pod_affinity
+            existing.pod_anti_affinity = task.pod_anti_affinity
             existing.labels = task.labels
             existing.ec_id = existing.compute_ec_id()
+            has = bool(existing.pod_affinity or existing.pod_anti_affinity)
+            self._pod_selector_tasks += int(has) - int(had)
+            if self._native is not None:
+                self._native.task_update(
+                    existing.uid, existing.ec_id, existing.cpu_request,
+                    existing.ram_request, existing.net_rx_request,
+                    existing.task_type,
+                )
             self.generation += 1
             return TaskReply.UPDATED_OK
 
@@ -289,6 +366,12 @@ class ClusterState:
             self.resource_to_machine[machine.uuid] = machine.uuid
             for sub in machine.subtree_uuids:
                 self.resource_to_machine[sub] = machine.uuid
+            if self._native is not None:
+                self._native.machine_add(
+                    self._nkey(machine.uuid), machine.cpu_capacity,
+                    machine.ram_capacity, machine.net_rx_capacity,
+                    machine.task_slots,
+                )
             self.generation += 1
             return NodeReply.ADDED_OK
 
@@ -298,6 +381,13 @@ class ClusterState:
             if task.scheduled_to == machine_uuid:
                 task.scheduled_to = None
                 task.state = TaskState.RUNNABLE
+                if self._native is not None:
+                    # RUNNABLE via set_state clears the binding without
+                    # ticking the wait escalator (eviction, not a failed
+                    # placement attempt).
+                    self._native.task_set_state(
+                        task.uid, int(TaskState.RUNNABLE)
+                    )
                 evicted.append(task.uid)
         return evicted
 
@@ -327,6 +417,8 @@ class ClusterState:
                 self.resource_to_machine.pop(sub, None)
             self.node_kb.pop(machine.uuid, None)
             self._evict_tasks_on(machine.uuid)
+            if self._native is not None:
+                self._native.machine_remove(self._nkey(machine.uuid))
             self.generation += 1
             return NodeReply.REMOVED_OK
 
@@ -347,6 +439,12 @@ class ClusterState:
                 existing.whare_stats = machine.whare_stats
             if machine.coco_penalties is not None:
                 existing.coco_penalties = machine.coco_penalties
+            if self._native is not None:
+                self._native.machine_update(
+                    self._nkey(existing.uuid), existing.cpu_capacity,
+                    existing.ram_capacity, existing.net_rx_capacity,
+                    existing.task_slots,
+                )
             for sub in machine.subtree_uuids:
                 existing.subtree_uuids.add(sub)
                 self.resource_to_machine[sub] = existing.uuid
@@ -412,17 +510,30 @@ class ClusterState:
                 else:
                     task.state = TaskState.RUNNING
                     task.wait_rounds = 0
+                if self._native is not None:
+                    self._native.task_place(
+                        uid,
+                        self._nkey(machine_uuid) if machine_uuid else 0,
+                    )
                 applied = True
             if applied:
                 # No-op batches leave the generation untouched so quiet
                 # rounds stay recognizable to the incremental fast path.
                 self.generation += 1
 
-    def build_round_view(self):
+    def build_round_view(self, include_running: bool = False):
         """Columnar tables for one round, built in a single pass under the
         lock (no per-task object copies: at 100k tasks the copy/per-object
-        property overhead of `snapshot()` costs ~1.5s of the <1s round
+        property overhead of a deep snapshot costs ~1.5s of the <1s round
         budget).
+
+        ``include_running=False`` (default, the reference's semantics):
+        only RUNNABLE tasks enter the solve; RUNNING tasks hold their
+        machines' resources as reservations (``cpu_used``/``ram_used``/
+        ``net_rx_used``/``slots``).  ``include_running=True`` re-enters
+        the whole workload for global re-optimization (the preemption /
+        rebalancing mode); reservations are then zero and the planner's
+        joint-capacity cuts take over.
 
         Returns a ``RoundView`` (defined in costmodel.base's vocabulary):
         EC/machine structure-of-arrays tables plus per-EC member arrays
@@ -433,31 +544,65 @@ class ClusterState:
 
         from poseidon_tpu.costmodel.base import ECTable, MachineTable
 
+        if self._native is not None:
+            return self._build_view_native(include_running)
+
         with self._lock:
             machines = [m for m in self.machines.values() if m.healthy]
             machines.sort(key=lambda m: m.uuid)
             uuid_to_col = {m.uuid: j for j, m in enumerate(machines)}
 
-            # Resident-task census by interference type and committed net
-            # bandwidth, accumulated in the same single pass (inputs to the
-            # whare/coco/net cost models).
+            # Resident-task census by interference type, committed
+            # resources, and slot usage, accumulated in the same single
+            # pass (inputs to the cost models and, in reservation mode,
+            # the machines' free-capacity accounting).
             census = np.zeros((len(machines), 4), dtype=np.int64)
             net_used = np.zeros(len(machines), dtype=np.int64)
+            cpu_used = np.zeros(len(machines), dtype=np.int64)
+            ram_used = np.zeros(len(machines), dtype=np.int64)
+            slots_used = np.zeros(len(machines), dtype=np.int32)
+            # Resident-label aggregates for pod-level affinity; collected
+            # only when some live task actually carries pod selectors.
+            collect_labels = self._pod_selector_tasks > 0
+            res_kv = [dict() for _ in machines] if collect_labels else None
+            res_key = [dict() for _ in machines] if collect_labels else None
+            res_total = (
+                np.zeros(len(machines), dtype=np.int64)
+                if collect_labels else None
+            )
 
+            schedulable = (
+                (TaskState.RUNNABLE, TaskState.RUNNING)
+                if include_running
+                else (TaskState.RUNNABLE,)
+            )
             groups: Dict[int, list] = {}
             reps: Dict[int, TaskInfo] = {}
             for t in self.tasks.values():
                 if t.state not in (TaskState.RUNNABLE, TaskState.RUNNING):
                     continue
-                g = groups.get(t.ec_id)
-                if g is None:
-                    groups[t.ec_id] = g = []
-                    reps[t.ec_id] = t
                 cur = uuid_to_col.get(t.scheduled_to, -1) \
                     if t.scheduled_to else -1
                 if cur >= 0:
                     census[cur, t.task_type & 3] += 1
                     net_used[cur] += t.net_rx_request
+                    if not include_running:
+                        cpu_used[cur] += t.cpu_request
+                        ram_used[cur] += t.ram_request
+                        slots_used[cur] += 1
+                    if collect_labels:
+                        res_total[cur] += 1
+                        kv = res_kv[cur]
+                        kk = res_key[cur]
+                        for k, v in t.labels.items():
+                            kv[(k, v)] = kv.get((k, v), 0) + 1
+                            kk[k] = kk.get(k, 0) + 1
+                if t.state not in schedulable:
+                    continue
+                g = groups.get(t.ec_id)
+                if g is None:
+                    groups[t.ec_id] = g = []
+                    reps[t.ec_id] = t
                 g.append((t.uid, cur, t.wait_rounds))
             # Descriptor-carried Whare-Map census (devils, rabbits, sheep,
             # turtles order folded into SHEEP/RABBIT/DEVIL/TURTLE columns).
@@ -522,6 +667,10 @@ class ClusterState:
                     [r.net_rx_request for r in rep_list], dtype=np.int64
                 ),
                 running_by_machine=running_by_machine,
+                is_gang=np.array([r.gang for r in rep_list], dtype=bool),
+                pod_affinity=[r.pod_affinity for r in rep_list],
+                pod_anti_affinity=[r.pod_anti_affinity for r in rep_list],
+                labels=[r.labels for r in rep_list],
             )
             mt = MachineTable(
                 uuids=[m.uuid for m in machines],
@@ -531,12 +680,14 @@ class ClusterState:
                 ram_capacity=np.array(
                     [m.ram_capacity for m in machines], np.int64
                 ),
-                cpu_used=np.zeros(len(machines), dtype=np.int64),
-                ram_used=np.zeros(len(machines), dtype=np.int64),
+                cpu_used=cpu_used,
+                ram_used=ram_used,
                 cpu_util=np.array([m.cpu_util for m in machines], np.float32),
                 mem_util=np.array([m.mem_util for m in machines], np.float32),
-                slots_free=np.array(
-                    [m.task_slots for m in machines], np.int32
+                slots_free=np.maximum(
+                    np.array([m.task_slots for m in machines], np.int32)
+                    - slots_used,
+                    0,
                 ),
                 labels=[m.labels for m in machines],
                 net_rx_capacity=np.array(
@@ -551,6 +702,150 @@ class ClusterState:
                     ],
                     dtype=np.int64,
                 ),
+                resident_kv=res_kv,
+                resident_key=res_key,
+                resident_total=res_total,
+            )
+            return RoundView(
+                ecs=ecs,
+                machines=mt,
+                member_uids=member_uids,
+                member_cur=member_cur,
+                member_wait=member_wait,
+                generation=self.generation,
+            )
+
+    def _build_view_native(self, include_running: bool):
+        """Round view via the C++ graph core: the O(N) aggregation,
+        grouping and sorting run native; Python assembles the per-EC
+        attribute tables from the (few) representative tasks."""
+        import numpy as np
+
+        from poseidon_tpu.costmodel.base import ECTable, MachineTable
+
+        with self._lock:
+            machines = [m for m in self.machines.values() if m.healthy]
+            machines.sort(key=lambda m: m.uuid)
+            keys = np.fromiter(
+                (self._nkey(m.uuid) for m in machines),
+                dtype=np.uint64, count=len(machines),
+            )
+            (ec_ids, offsets, uids, cur, wait, census, cpu_used, ram_used,
+             net_used, slots_used) = self._native.build_view(
+                keys, include_running
+            )
+            E, M = ec_ids.shape[0], len(machines)
+
+            member_uids, member_cur, member_wait = [], [], []
+            supply = np.empty(E, dtype=np.int32)
+            max_wait = np.empty(E, dtype=np.int32)
+            running_by_machine = np.zeros((E, M), dtype=np.int32)
+            rep_list = []
+            for i in range(E):
+                o, o2 = int(offsets[i]), int(offsets[i + 1])
+                member_uids.append(uids[o:o2])
+                member_cur.append(cur[o:o2])
+                member_wait.append(wait[o:o2])
+                supply[i] = o2 - o
+                max_wait[i] = int(wait[o:o2].max()) if o2 > o else 0
+                placed = cur[o:o2][cur[o:o2] >= 0]
+                if placed.size:
+                    running_by_machine[i] = np.bincount(
+                        placed, minlength=M
+                    )
+                rep_list.append(self.tasks[int(uids[o])])
+
+            # Resident-label aggregates (pod-level affinity) stay a
+            # Python pass — only when some live task carries pod
+            # selectors; labels never cross the native boundary.
+            res_kv = res_key = res_total = None
+            if self._pod_selector_tasks > 0:
+                uuid_to_col = {m.uuid: j for j, m in enumerate(machines)}
+                res_kv = [dict() for _ in machines]
+                res_key = [dict() for _ in machines]
+                res_total = np.zeros(M, dtype=np.int64)
+                for t in self.tasks.values():
+                    if t.state not in (TaskState.RUNNABLE, TaskState.RUNNING):
+                        continue
+                    col = uuid_to_col.get(t.scheduled_to, -1) \
+                        if t.scheduled_to else -1
+                    if col < 0:
+                        continue
+                    res_total[col] += 1
+                    kv = res_kv[col]
+                    kk = res_key[col]
+                    for k, v in t.labels.items():
+                        kv[(k, v)] = kv.get((k, v), 0) + 1
+                        kk[k] = kk.get(k, 0) + 1
+
+            # Descriptor-carried Whare-Map census on top of the live one.
+            for j, m in enumerate(machines):
+                if m.whare_stats is not None:
+                    _idle, dev, rab, shp, tur = m.whare_stats
+                    census[j, 0] += shp
+                    census[j, 1] += rab
+                    census[j, 2] += dev
+                    census[j, 3] += tur
+
+            ecs = ECTable(
+                ec_ids=ec_ids,
+                cpu_request=np.array(
+                    [r.cpu_request for r in rep_list], dtype=np.int64
+                ),
+                ram_request=np.array(
+                    [r.ram_request for r in rep_list], dtype=np.int64
+                ),
+                supply=supply,
+                priority=np.array(
+                    [r.priority for r in rep_list], dtype=np.int32
+                ),
+                task_type=np.array(
+                    [r.task_type for r in rep_list], dtype=np.int32
+                ),
+                max_wait_rounds=max_wait,
+                selectors=[r.selectors for r in rep_list],
+                net_rx_request=np.array(
+                    [r.net_rx_request for r in rep_list], dtype=np.int64
+                ),
+                running_by_machine=running_by_machine,
+                is_gang=np.array([r.gang for r in rep_list], dtype=bool),
+                pod_affinity=[r.pod_affinity for r in rep_list],
+                pod_anti_affinity=[r.pod_anti_affinity for r in rep_list],
+                labels=[r.labels for r in rep_list],
+            )
+            mt = MachineTable(
+                uuids=[m.uuid for m in machines],
+                cpu_capacity=np.array(
+                    [m.cpu_capacity for m in machines], np.int64
+                ),
+                ram_capacity=np.array(
+                    [m.ram_capacity for m in machines], np.int64
+                ),
+                cpu_used=cpu_used,
+                ram_used=ram_used,
+                cpu_util=np.array([m.cpu_util for m in machines], np.float32),
+                mem_util=np.array([m.mem_util for m in machines], np.float32),
+                slots_free=np.maximum(
+                    np.array([m.task_slots for m in machines], np.int32)
+                    - slots_used,
+                    0,
+                ),
+                labels=[m.labels for m in machines],
+                net_rx_capacity=np.array(
+                    [m.net_rx_capacity for m in machines], np.int64
+                ),
+                net_rx_used=net_used,
+                type_census=census,
+                coco_penalties=np.array(
+                    [
+                        m.coco_penalties or (0, 0, 0, 0)
+                        for m in machines
+                    ],
+                    dtype=np.int64,
+                ),
+                resident_kv=res_kv,
+                resident_key=res_key,
+                resident_total=res_total,
             )
             return RoundView(
                 ecs=ecs,

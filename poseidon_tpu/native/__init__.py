@@ -1,0 +1,11 @@
+"""Native (C++) runtime components.
+
+``graphcore`` is the incremental flow-graph state core (the analog of the
+reference scheduler's C++ graph manager); built on demand with g++ into a
+shared object and bound via ctypes.  Python falls back to the pure-Python
+round-view builder when the toolchain is unavailable.
+"""
+
+from poseidon_tpu.native.bindings import NativeGraphCore, native_available
+
+__all__ = ["NativeGraphCore", "native_available"]

@@ -392,11 +392,23 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
     return F, Ffb, prices, iters
 
 
+# The epsilon ladder always has this many phases: values are traced (no
+# recompile when they change), only the LENGTH is shape-static, and a
+# fixed length means one compile per array shape.  Ladder factor 16 from
+# eps0 <= COST_CAP^2/2 < 2*16^7 always reaches 1 within 8 entries; phases
+# whose epsilon repeats are near-no-ops (the refine keeps all flows and
+# no node is active).
+NUM_PHASES = 8
+
+
 def _host_validate(costs, supply, capacity, unsched_cost, scale, eps_start):
     """Input validation + scale/epsilon-schedule derivation (host side).
 
     Shared by the single-chip and mesh-sharded entry points.  Returns
-    ``(scale, eps_sched)``.
+    ``(scale, eps_sched)``.  The scale is derived from the cost bound
+    rounded UP to a power of two: jit treats the scale as a static
+    argument, so per-round drift in the raw cost range must not mint
+    fresh compile keys.
     """
     finite = costs[costs < INF_COST]
     if finite.size and finite.max() > COST_CAP:
@@ -409,24 +421,19 @@ def _host_validate(costs, supply, capacity, unsched_cost, scale, eps_start):
     E, M = costs.shape
     max_raw = int(max(finite.max() if finite.size else 0,
                       unsched_cost.max(initial=0), 1))
+    max_raw_q = 1 << (max_raw - 1).bit_length() if max_raw > 1 else 1
+    max_raw_q = min(max_raw_q, COST_CAP)
     if scale is None:
-        scale = choose_scale(E, M, max_raw)
+        scale = choose_scale(E, M, max_raw_q)
 
-    # Epsilon schedule from the instance's actual cost magnitude (host side:
-    # static length per bucket, so distinct magnitudes cost at most a handful
-    # of recompiles).
-    max_c = int(max(finite.max() if finite.size else 0,
-                    unsched_cost.max(initial=0))) * scale
-    max_c = max(max_c, 1)
-    # Ladder factor 16: with the global-update heuristic the aggressive
-    # schedule converges in the same number of sweeps as factor 4 but with
-    # a third of the phases (measured; objectives identical).  A warm
-    # incremental re-solve starts the ladder at eps_start (pass something
-    # like the scaled magnitude of the cost deltas since the last round).
+    # Epsilon schedule from the (quantized) cost magnitude.  A warm
+    # incremental re-solve starts the ladder at eps_start (the scaled
+    # magnitude of the cost drift since the last round).
+    max_c = max(max_raw_q * scale, 1)
     eps0 = max_c // 2 if eps_start is None else max(1, int(eps_start))
-    eps_list = [max(1, eps0 // 16**k) for k in range(32)]
-    num_phases = next(i for i, e in enumerate(eps_list) if e == 1) + 1
-    eps_sched = np.asarray(eps_list[:num_phases], dtype=np.int32)
+    eps_sched = np.asarray(
+        [max(1, eps0 // 16**k) for k in range(NUM_PHASES)], dtype=np.int32
+    )
     return scale, eps_sched
 
 
@@ -531,36 +538,60 @@ def solve_transport(
             gap_bound=0.0,
             iterations=0,
         )
+    # Pad EC rows to a power of two (min 8): row counts churn round to
+    # round, and every distinct shape is a fresh XLA compile.  Padded rows
+    # have zero supply and no admissible arcs, so they are inert.
+    E_pad = max(8, 1 << (E - 1).bit_length())
+    if E_pad != E:
+        costs_p = np.full((E_pad, M), INF_COST, dtype=np.int32)
+        costs_p[:E] = costs
+        supply_p = np.zeros(E_pad, dtype=np.int32)
+        supply_p[:E] = supply
+        unsched_p = np.ones(E_pad, dtype=np.int32)
+        unsched_p[:E] = unsched_cost
+    else:
+        costs_p, supply_p, unsched_p = costs, supply, unsched_cost
+
     scale, eps_sched = _host_validate(
-        costs, supply, capacity, unsched_cost, scale, eps_start
+        costs_p, supply_p, capacity, unsched_p, scale, eps_start
     )
-    if init_prices is None:
-        init_prices = np.zeros(E + M + 1, dtype=np.int32)
+    prices_p = np.zeros(E_pad + M + 1, dtype=np.int32)
+    if init_prices is not None:
+        init_prices = np.asarray(init_prices, dtype=np.int32)
+        prices_p[:E] = init_prices[:E]
+        prices_p[E_pad:] = init_prices[E:]
 
     J = max(2, min(bid_ranks, M + 1))
 
-    if init_flows is None:
-        init_flows = np.zeros((E, M), dtype=np.int32)
-    if init_unsched is None:
-        init_unsched = np.zeros(E, dtype=np.int32)
-    if arc_capacity is None:
-        arc_capacity = np.full((E, M), _POS, dtype=np.int32)
-    else:
+    flows_p = np.zeros((E_pad, M), dtype=np.int32)
+    if init_flows is not None:
+        flows_p[:E] = init_flows
+    fb_p = np.zeros(E_pad, dtype=np.int32)
+    if init_unsched is not None:
+        fb_p[:E] = init_unsched
+    arc_p = np.full((E_pad, M), _POS, dtype=np.int32)
+    if arc_capacity is not None:
         arc_capacity = np.asarray(arc_capacity, dtype=np.int32)
         if (arc_capacity < 0).any():
             raise ValueError("arc_capacity must be non-negative")
+        arc_p[:E] = arc_capacity
+    arc_p[E:] = 0
 
     flows, unsched, prices, iters = _solve_device(
-        jnp.asarray(costs), jnp.asarray(supply), jnp.asarray(capacity),
-        jnp.asarray(unsched_cost), jnp.asarray(arc_capacity),
-        jnp.asarray(init_prices, dtype=jnp.int32),
-        jnp.asarray(init_flows, dtype=jnp.int32),
-        jnp.asarray(init_unsched, dtype=jnp.int32),
+        jnp.asarray(costs_p), jnp.asarray(supply_p), jnp.asarray(capacity),
+        jnp.asarray(unsched_p), jnp.asarray(arc_p),
+        jnp.asarray(prices_p),
+        jnp.asarray(flows_p),
+        jnp.asarray(fb_p),
         jnp.asarray(eps_sched),
         J=J, max_iter=max_iter_per_phase, scale=int(scale),
     )
+    flows = np.asarray(flows)[:E]
+    unsched = np.asarray(unsched)[:E]
+    prices_full = np.asarray(prices)
+    prices_out = np.concatenate([prices_full[:E], prices_full[E_pad:]])
     return _host_finalize(
-        flows, unsched, prices, iters,
+        flows, unsched, prices_out, iters,
         costs=costs, supply=supply, capacity=capacity,
         unsched_cost=unsched_cost, scale=scale,
     )

@@ -102,32 +102,42 @@ def solve_transport_sharded(
             scale=scale,
         )
 
-    scale, eps_sched = _host_validate(
-        costs, supply, capacity, unsched_cost, scale, eps_start
-    )
-
+    # Pad machines to a mesh multiple and EC rows to a power of two (the
+    # same shape-stability rationale as the single-chip wrapper): dead
+    # columns/rows have zero capacity/supply and no admissible arcs.
     m_pad = ((M + n_dev - 1) // n_dev) * n_dev
-    costs_p = _pad_columns(costs, m_pad, INF_COST)
+    e_pad = max(8, 1 << (E - 1).bit_length())
+    costs_p = np.full((e_pad, m_pad), INF_COST, dtype=np.int32)
+    costs_p[:E, :M] = costs
+    supply_p = np.zeros(e_pad, dtype=np.int32)
+    supply_p[:E] = supply
+    unsched_p = np.ones(e_pad, dtype=np.int32)
+    unsched_p[:E] = unsched_cost
     capacity_p = _pad_columns(capacity, m_pad, 0)
+    arc_cap_p = np.zeros((e_pad, m_pad), dtype=np.int32)
     if arc_capacity is None:
-        arc_cap_p = np.full((E, m_pad), _POS, dtype=np.int32)
+        arc_cap_p[:E, :M] = _POS
     else:
         arc_capacity = np.asarray(arc_capacity, dtype=np.int32)
         if (arc_capacity < 0).any():
             raise ValueError("arc_capacity must be non-negative")
-        arc_cap_p = _pad_columns(arc_capacity, m_pad, 0)
-    if init_flows is None:
-        flows_p = np.zeros((E, m_pad), dtype=np.int32)
-    else:
-        flows_p = _pad_columns(np.asarray(init_flows, dtype=np.int32), m_pad, 0)
-    if init_unsched is None:
-        init_unsched = np.zeros(E, dtype=np.int32)
-    prices_p = np.zeros(E + m_pad + 1, dtype=np.int32)
+        arc_cap_p[:E, :M] = arc_capacity
+    flows_p = np.zeros((e_pad, m_pad), dtype=np.int32)
+    if init_flows is not None:
+        flows_p[:E, :M] = init_flows
+    fb_p = np.zeros(e_pad, dtype=np.int32)
+    if init_unsched is not None:
+        fb_p[:E] = init_unsched
+    prices_p = np.zeros(e_pad + m_pad + 1, dtype=np.int32)
     if init_prices is not None:
         init_prices = np.asarray(init_prices, dtype=np.int32)
         prices_p[:E] = init_prices[:E]
-        prices_p[E : E + M] = init_prices[E : E + M]
-        prices_p[E + m_pad] = init_prices[E + M]
+        prices_p[e_pad : e_pad + M] = init_prices[E : E + M]
+        prices_p[e_pad + m_pad] = init_prices[E + M]
+
+    scale, eps_sched = _host_validate(
+        costs_p, supply_p, capacity_p, unsched_p, scale, eps_start
+    )
 
     col = NamedSharding(mesh, P(None, MACHINE_AXIS))   # [E, M] matrices
     vec_m = NamedSharding(mesh, P(MACHINE_AXIS))       # [M] vectors
@@ -137,23 +147,25 @@ def solve_transport_sharded(
     put = jax.device_put
     flows, unsched, prices, iters = _solve_device(
         put(jnp.asarray(costs_p), col),
-        put(jnp.asarray(supply), repl),
+        put(jnp.asarray(supply_p), repl),
         put(jnp.asarray(capacity_p), vec_m),
-        put(jnp.asarray(unsched_cost), repl),
+        put(jnp.asarray(unsched_p), repl),
         put(jnp.asarray(arc_cap_p), col),
         # Prices mix both node classes in one [E+M+1] vector; replicated
         # (it is O(E+M) — the O(E*M) matrices are what must shard).
         put(jnp.asarray(prices_p), repl),
         put(jnp.asarray(flows_p), col),
-        put(jnp.asarray(init_unsched, dtype=jnp.int32), repl),
+        put(jnp.asarray(fb_p), repl),
         put(jnp.asarray(eps_sched), repl),
         J=J, max_iter=max_iter_per_phase, scale=int(scale),
     )
 
-    flows = np.asarray(flows)[:, :M]
+    flows = np.asarray(flows)[:E, :M]
+    unsched = np.asarray(unsched)[:E]
     prices_full = np.asarray(prices)
     prices_out = np.concatenate(
-        [prices_full[:E], prices_full[E : E + M], prices_full[E + m_pad :]]
+        [prices_full[:E], prices_full[e_pad : e_pad + M],
+         prices_full[e_pad + m_pad :]]
     )
     return _host_finalize(
         flows, unsched, prices_out, iters,

@@ -1,0 +1,22 @@
+"""Trace replay: cluster-trace-driven scheduling simulation.
+
+The reference's data model carries trace-replay hooks
+(``trace_job_id``/``trace_task_id``, task_desc.proto:98-99;
+``trace_machine_id``, resource_desc.proto:80) because Firmament was
+validated by replaying the Google cluster trace (README.md:4, OSDI'16).
+The repo itself ships no replay harness — SURVEY.md section 4 flags that
+as the gap this package fills: a synthetic Google-trace-shaped workload
+generator plus a driver that replays it against the scheduler (in-process
+planner or the full gRPC service) and reports per-round latency and
+placement quality.
+"""
+
+from poseidon_tpu.replay.trace import TraceEvent, synthesize_trace
+from poseidon_tpu.replay.driver import ReplayDriver, ReplayReport
+
+__all__ = [
+    "TraceEvent",
+    "synthesize_trace",
+    "ReplayDriver",
+    "ReplayReport",
+]

@@ -1,0 +1,154 @@
+"""Replay driver: trace events -> scheduler rounds -> report.
+
+Replays a trace in virtual time against the in-process planner (the same
+code path the gRPC service's ``Schedule()`` runs): between scheduling
+rounds, due events mutate ClusterState exactly as the watcher RPCs would;
+tasks that have been running for their duration complete.  Produces the
+BASELINE metrics: per-round latency percentiles, placement totals, and the
+cost objective — the driver for the 10k-node/100k-pod config 5.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from poseidon_tpu.costmodel import get_cost_model
+from poseidon_tpu.graph.instance import RoundPlanner
+from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+from poseidon_tpu.replay.trace import TraceEvent
+from poseidon_tpu.utils.ids import generate_uuid, task_uid
+
+
+@dataclass
+class ReplayReport:
+    rounds: int = 0
+    tasks_submitted: int = 0
+    tasks_completed: int = 0
+    placed: int = 0
+    preempted: int = 0
+    migrated: int = 0
+    round_seconds: List[float] = field(default_factory=list)
+    solve_seconds: List[float] = field(default_factory=list)
+    final_unscheduled: int = 0
+    total_objective: int = 0
+
+    def percentile(self, q: float) -> float:
+        return float(np.percentile(self.round_seconds, q)) \
+            if self.round_seconds else 0.0
+
+    def summary(self) -> dict:
+        return {
+            "rounds": self.rounds,
+            "tasks_submitted": self.tasks_submitted,
+            "tasks_completed": self.tasks_completed,
+            "placed": self.placed,
+            "preempted": self.preempted,
+            "migrated": self.migrated,
+            "round_p50_s": round(self.percentile(50), 4),
+            "round_p99_s": round(self.percentile(99), 4),
+            "solve_p50_s": (
+                round(float(np.percentile(self.solve_seconds, 50)), 4)
+                if self.solve_seconds else 0.0
+            ),
+            "final_unscheduled": self.final_unscheduled,
+        }
+
+
+class ReplayDriver:
+    def __init__(
+        self,
+        events: List[TraceEvent],
+        *,
+        cost_model: str = "cpu_mem",
+        round_interval_s: float = 10.0,
+        gang_jobs: bool = False,
+    ) -> None:
+        self.events = sorted(events, key=lambda e: (e.time, e.kind))
+        self.state = ClusterState()
+        self.planner = RoundPlanner(self.state, get_cost_model(cost_model))
+        self.round_interval_s = round_interval_s
+        self.gang_jobs = gang_jobs
+        # (end_time, job_id, task_uid) min-heap of running tasks.
+        self._ending: list = []
+        self._durations: dict = {}
+
+    def _apply_event(self, ev: TraceEvent) -> int:
+        if ev.kind == "machine_add":
+            mid, cpu, ram = ev.payload
+            self.state.node_added(
+                MachineInfo(
+                    uuid=generate_uuid(f"trace-m{mid}"),
+                    cpu_capacity=cpu,
+                    ram_capacity=ram,
+                    trace_machine_id=mid,
+                )
+            )
+            return 0
+        if ev.kind == "job_submit":
+            job, n, cpu, ram, duration = ev.payload
+            job_uuid = generate_uuid(f"trace-j{job}")
+            for i in range(n):
+                uid = task_uid(job_uuid, i)
+                self.state.task_submitted(
+                    TaskInfo(
+                        uid=uid, job_id=job_uuid, cpu_request=cpu,
+                        ram_request=ram, gang=self.gang_jobs,
+                        trace_job_id=job, trace_task_id=i,
+                    )
+                )
+                self._durations[uid] = duration
+            return n
+        raise ValueError(f"unknown trace event kind {ev.kind}")
+
+    def _complete_due(self, now: float) -> int:
+        done = 0
+        while self._ending and self._ending[0][0] <= now:
+            _, uid = heapq.heappop(self._ending)
+            task = self.state.tasks.get(uid)
+            if task is None:
+                continue
+            self.state.task_completed(uid)
+            self.state.task_removed(uid)
+            done += 1
+        return done
+
+    def run(self, max_rounds: Optional[int] = None) -> ReplayReport:
+        report = ReplayReport()
+        now = 0.0
+        i = 0
+        n_events = len(self.events)
+        while i < n_events or self._ending:
+            # Apply everything due up to the end of this interval.
+            horizon = now + self.round_interval_s
+            while i < n_events and self.events[i].time <= horizon:
+                report.tasks_submitted += self._apply_event(self.events[i])
+                i += 1
+            report.tasks_completed += self._complete_due(horizon)
+
+            deltas, metrics = self.planner.schedule_round()
+            report.rounds += 1
+            report.round_seconds.append(metrics.total_seconds)
+            report.solve_seconds.append(metrics.solve_seconds)
+            report.placed += metrics.placed
+            report.preempted += metrics.preempted
+            report.migrated += metrics.migrated
+            report.total_objective += metrics.objective
+
+            # Newly placed tasks start their duration clock.
+            for d in deltas:
+                if d.type == 1:  # PLACE
+                    dur = self._durations.get(d.task_id)
+                    if dur is not None:
+                        heapq.heappush(
+                            self._ending, (horizon + dur, d.task_id)
+                        )
+            now = horizon
+            if max_rounds is not None and report.rounds >= max_rounds:
+                break
+        report.final_unscheduled = self.planner.last_metrics.unscheduled
+        return report

@@ -27,6 +27,7 @@ def task_info_from_proto(td: fpb.TaskDescriptor, job_id: str = "") -> TaskInfo:
     keys jobs by the descriptor uuid, podwatcher.go:262-268).
     """
     req = td.resource_request
+    labels = labels_to_dict(td.labels)
     return TaskInfo(
         uid=int(td.uid),
         job_id=job_id or td.job_id,
@@ -37,7 +38,12 @@ def task_info_from_proto(td: fpb.TaskDescriptor, job_id: str = "") -> TaskInfo:
         priority=int(td.priority),
         task_type=int(td.task_type),
         selectors=canonical_selectors(td.label_selectors),
-        labels=labels_to_dict(td.labels),
+        pod_affinity=canonical_selectors(td.pod_affinity),
+        pod_anti_affinity=canonical_selectors(td.pod_anti_affinity),
+        labels=labels,
+        # The gangScheduling pod label makes the whole job place
+        # atomically (BASELINE config 4).
+        gang=labels.get("gangScheduling", "").lower() == "true",
         # Carried binding (restart recovery): the state machine adopts it
         # when the resource resolves to a known machine.
         scheduled_to=td.scheduled_to_resource or None,

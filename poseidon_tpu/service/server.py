@@ -60,7 +60,13 @@ class FirmamentServicer:
 
     def Schedule(self, request, context):
         with self._schedule_lock:
-            deltas, metrics = self.planner.schedule_round()
+            if self.config.profile_dir:
+                import jax
+
+                with jax.profiler.trace(self.config.profile_dir):
+                    deltas, metrics = self.planner.schedule_round()
+            else:
+                deltas, metrics = self.planner.schedule_round()
         log.info(
             "round %d: %d tasks / %d ECs / %d machines -> "
             "%d place %d preempt %d migrate %d unsched; "

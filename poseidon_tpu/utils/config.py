@@ -52,6 +52,9 @@ class FirmamentTPUConfig:
     pod_affinity: bool = False
     # Number of devices to shard the solve over (1 = single chip).
     solver_devices: int = 1
+    # When set, each Schedule() round is captured with the JAX profiler
+    # into this directory (xprof trace; SURVEY.md section 5).
+    profile_dir: str = ""
     config_file: str = ""
 
 

@@ -1,0 +1,172 @@
+"""Gang scheduling: all-or-nothing job placement (BASELINE config 4).
+
+Each gang job is its own EC row by signature construction; the planner's
+repair loop forbids partially-placed gangs and re-solves so freed capacity
+serves other work.
+"""
+
+import numpy as np
+
+from poseidon_tpu.costmodel import get_cost_model
+from poseidon_tpu.glue import FakeKube, Node, Pod, Poseidon
+from poseidon_tpu.graph.instance import RoundPlanner
+from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+from poseidon_tpu.service import FirmamentTPUServer
+from poseidon_tpu.utils.config import PoseidonConfig
+from poseidon_tpu.utils.ids import generate_uuid, task_uid
+
+
+def gang_task(uid, job, cpu=1000, ram=1 << 18):
+    return TaskInfo(
+        uid=uid, job_id=job, cpu_request=cpu, ram_request=ram, gang=True,
+        labels={"gangScheduling": "true"},
+    )
+
+
+def test_gang_places_fully_when_it_fits():
+    st = ClusterState()
+    for i in range(4):
+        st.node_added(
+            MachineInfo(uuid=generate_uuid(f"g{i}"), cpu_capacity=2000,
+                        ram_capacity=1 << 24)
+        )
+    for i in range(6):
+        st.task_submitted(gang_task(task_uid("gj", i), "gang-job"))
+    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+    deltas, m = planner.schedule_round()
+    assert m.placed == 6 and m.unscheduled == 0
+
+
+def test_partial_gang_fully_unscheduled():
+    st = ClusterState()
+    # Capacity for 3 x 1000m; the 5-member gang cannot fully fit.
+    for i in range(3):
+        st.node_added(
+            MachineInfo(uuid=generate_uuid(f"g{i}"), cpu_capacity=1000,
+                        ram_capacity=1 << 24)
+        )
+    for i in range(5):
+        st.task_submitted(gang_task(task_uid("gj", i), "gang-big"))
+    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+    deltas, m = planner.schedule_round()
+    assert m.placed == 0
+    assert m.unscheduled == 5
+    assert deltas == []
+
+
+def test_forbidden_gang_frees_capacity_for_others():
+    st = ClusterState()
+    for i in range(3):
+        st.node_added(
+            MachineInfo(uuid=generate_uuid(f"g{i}"), cpu_capacity=1000,
+                        ram_capacity=1 << 24)
+        )
+    # A 5-member gang that cannot fit, plus 3 singletons that can.
+    for i in range(5):
+        st.task_submitted(gang_task(task_uid("gang", i), "gang-big"))
+    for i in range(3):
+        st.task_submitted(
+            TaskInfo(uid=task_uid("solo", i), job_id=f"solo-{i}",
+                     cpu_request=1000, ram_request=1 << 18)
+        )
+    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+    deltas, m = planner.schedule_round()
+    # All three singletons run; the gang waits whole.
+    assert m.placed == 3
+    assert m.unscheduled == 5
+
+
+def test_gang_schedules_when_capacity_arrives():
+    st = ClusterState()
+    st.node_added(
+        MachineInfo(uuid=generate_uuid("first"), cpu_capacity=2000,
+                    ram_capacity=1 << 24)
+    )
+    for i in range(4):
+        st.task_submitted(gang_task(task_uid("gw", i), "gang-wait"))
+    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+    _, m1 = planner.schedule_round()
+    assert m1.placed == 0 and m1.unscheduled == 4
+    # Another machine joins: now 4000m total fits the 4x1000m gang.
+    st.node_added(
+        MachineInfo(uuid=generate_uuid("second"), cpu_capacity=2000,
+                    ram_capacity=1 << 24)
+    )
+    _, m2 = planner.schedule_round()
+    assert m2.placed == 4 and m2.unscheduled == 0
+
+
+def test_two_gangs_compete_one_wins_whole():
+    st = ClusterState()
+    for i in range(3):
+        st.node_added(
+            MachineInfo(uuid=generate_uuid(f"c{i}"), cpu_capacity=1000,
+                        ram_capacity=1 << 24)
+        )
+    for i in range(2):
+        st.task_submitted(gang_task(task_uid("ga", i), "gang-a"))
+    for i in range(2):
+        st.task_submitted(gang_task(task_uid("gb", i), "gang-b"))
+    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+    _, m = planner.schedule_round()
+    # 3 slots, two 2-member gangs: exactly one gang runs whole.
+    assert m.placed == 2 and m.unscheduled == 2
+
+
+def test_cross_ec_overcommit_prevented():
+    """Two distinct ECs must not jointly oversubscribe one machine's CPU
+    (the transportation relaxation allows it; the feasibility loop cuts
+    it).  Regression for the 2x-CPU over-commit the two-gang test exposed."""
+    st = ClusterState()
+    st.node_added(
+        MachineInfo(uuid=generate_uuid("only"), cpu_capacity=1000,
+                    ram_capacity=1 << 24)
+    )
+    # Two singleton tasks of *different* shapes, each 700m: only one fits.
+    st.task_submitted(TaskInfo(uid=1, job_id="a", cpu_request=700,
+                               ram_request=1 << 18))
+    st.task_submitted(TaskInfo(uid=2, job_id="b", cpu_request=700,
+                               ram_request=1 << 19))
+    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+    _, m = planner.schedule_round()
+    assert m.placed == 1 and m.unscheduled == 1
+
+
+def test_overcommit_check_all_dimensions():
+    st = ClusterState()
+    st.node_added(
+        MachineInfo(uuid=generate_uuid("ram-bound"), cpu_capacity=100_000,
+                    ram_capacity=1 << 20)
+    )
+    # RAM is the binding dimension: 3 x 600KB into 1MB -> only one fits.
+    st.task_submitted(TaskInfo(uid=1, job_id="a", cpu_request=100,
+                               ram_request=600 << 10))
+    st.task_submitted(TaskInfo(uid=2, job_id="b", cpu_request=200,
+                               ram_request=600 << 10))
+    st.task_submitted(TaskInfo(uid=3, job_id="c", cpu_request=300,
+                               ram_request=600 << 10))
+    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+    _, m = planner.schedule_round()
+    assert m.placed == 1 and m.unscheduled == 2
+
+
+def test_gang_label_over_the_wire():
+    kube = FakeKube()
+    for i in range(2):
+        kube.add_node(Node(name=f"n{i}", cpu_capacity=1000,
+                           ram_capacity=1 << 24))
+    with FirmamentTPUServer(address="127.0.0.1:0") as server:
+        cfg = PoseidonConfig(firmament_address=server.address,
+                             scheduling_interval=3600)
+        with Poseidon(kube, config=cfg, run_loop=False) as poseidon:
+            for i in range(3):
+                kube.create_pod(
+                    Pod(name=f"g{i}", owner_uid="gang-rs",
+                        cpu_request=900, ram_request=1 << 18,
+                        labels={"gangScheduling": "true"})
+                )
+            assert poseidon.drain_watchers()
+            deltas = poseidon.schedule_once()
+            # Only 2 of 3 members could fit: nothing places.
+            assert deltas == []
+            assert all(p.phase == "Pending" for p in kube.pods.values())

@@ -16,14 +16,18 @@ from poseidon_tpu.utils.ids import generate_uuid, task_uid
 
 
 def make_state(num_machines=12, num_tasks=60, seed=0):
+    # Machines large enough that per-machine capacity never binds: the
+    # incremental paths are then exercised against the pure transportation
+    # relaxation (which the exact oracle also solves), without the
+    # planner's joint-capacity cuts entering the comparison.
     rng = np.random.default_rng(seed)
     st = ClusterState()
     for i in range(num_machines):
         st.node_added(
             MachineInfo(
                 uuid=generate_uuid(f"im{i}"),
-                cpu_capacity=int(rng.integers(4000, 16000)),
-                ram_capacity=int(rng.integers(1 << 22, 1 << 25)),
+                cpu_capacity=int(rng.integers(32000, 64000)),
+                ram_capacity=int(rng.integers(1 << 26, 1 << 27)),
             )
         )
     shapes = [(100, 1 << 18), (500, 1 << 19), (1500, 1 << 20), (250, 1 << 18)]
@@ -54,7 +58,11 @@ def test_quiet_round_fast_path():
                  cpu_request=100, ram_request=1 << 18)
     )
     deltas3, m3 = planner.schedule_round()
-    assert len(deltas3) == 1 and m3.iterations > 0
+    assert m3.iterations > 0 and m3.placed == 1
+    # The re-solve may migrate toward a cheaper optimum; it must then
+    # settle: the following round is quiet again.
+    deltas4, m4 = planner.schedule_round()
+    assert deltas4 == [] and m4.iterations == 0
 
 
 def test_incremental_matches_cold_over_churn():
@@ -93,14 +101,18 @@ def test_incremental_matches_cold_over_churn():
 
 
 def test_incremental_solve_parity_with_oracle():
+    # Global-rescheduling mode: every round re-solves the whole workload,
+    # so a stats drift re-prices running tasks too.
     st = make_state(num_machines=8, num_tasks=40, seed=9)
-    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+    planner = RoundPlanner(
+        st, get_cost_model("cpu_mem"), reschedule_running=True
+    )
     planner.schedule_round()
     # Stats drift changes arc costs without changing admissibility: the
     # epsilon-start path must still land on the exact optimum.
     for uuid in list(st.machines)[:4]:
         st.add_node_stats(uuid, {"cpu_utilization": 0.9, "mem_utilization": 0.8})
-    view = st.build_round_view()
+    view = st.build_round_view(include_running=True)
     cm = planner.cost_model.build(view.ecs, view.machines)
     _, metrics = planner.schedule_round()
     want = transport_objective(

@@ -1,0 +1,144 @@
+"""Native C++ graph core: availability, parity, and differential fuzzing.
+
+The native core mirrors every ClusterState mutation; any divergence
+between its round view and the pure-Python builder is a bug in one of
+them.  The fuzz drives a long random mutation sequence through two states
+(one native, one pure-Python) and compares the views field by field.
+"""
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.costmodel import get_cost_model
+from poseidon_tpu.graph.instance import RoundPlanner
+from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+from poseidon_tpu.native import native_available
+from poseidon_tpu.utils.ids import generate_uuid, task_uid
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native toolchain unavailable"
+)
+
+
+def make_machine(i, **kw):
+    defaults = dict(cpu_capacity=8000, ram_capacity=1 << 24,
+                    net_rx_capacity=1000)
+    defaults.update(kw)
+    return MachineInfo(uuid=generate_uuid(f"nm{i}"), **defaults)
+
+
+def make_task(i, **kw):
+    defaults = dict(cpu_request=100 * (1 + i % 5), ram_request=1 << 18)
+    defaults.update(kw)
+    return TaskInfo(uid=task_uid("njob", i), job_id=f"njob-{i % 3}",
+                    **defaults)
+
+
+def assert_views_equal(va, vb):
+    np.testing.assert_array_equal(va.ecs.ec_ids, vb.ecs.ec_ids)
+    np.testing.assert_array_equal(va.ecs.supply, vb.ecs.supply)
+    np.testing.assert_array_equal(va.ecs.cpu_request, vb.ecs.cpu_request)
+    np.testing.assert_array_equal(va.ecs.max_wait_rounds,
+                                  vb.ecs.max_wait_rounds)
+    np.testing.assert_array_equal(va.ecs.is_gang, vb.ecs.is_gang)
+    np.testing.assert_array_equal(va.ecs.running_by_machine,
+                                  vb.ecs.running_by_machine)
+    assert va.machines.uuids == vb.machines.uuids
+    np.testing.assert_array_equal(va.machines.cpu_used, vb.machines.cpu_used)
+    np.testing.assert_array_equal(va.machines.ram_used, vb.machines.ram_used)
+    np.testing.assert_array_equal(va.machines.net_rx_used,
+                                  vb.machines.net_rx_used)
+    np.testing.assert_array_equal(va.machines.slots_free,
+                                  vb.machines.slots_free)
+    np.testing.assert_array_equal(va.machines.type_census,
+                                  vb.machines.type_census)
+    for i in range(len(va.member_uids)):
+        np.testing.assert_array_equal(va.member_uids[i], vb.member_uids[i])
+        np.testing.assert_array_equal(va.member_cur[i], vb.member_cur[i])
+        np.testing.assert_array_equal(va.member_wait[i], vb.member_wait[i])
+
+
+def test_native_is_active_by_default():
+    st = ClusterState()
+    assert st._native is not None
+
+
+def test_differential_fuzz():
+    rng = np.random.default_rng(5)
+    st_n = ClusterState(use_native=True)
+    st_p = ClusterState(use_native=False)
+    assert st_n._native is not None and st_p._native is None
+
+    live_machines = []
+    live_tasks = []
+    for step in range(400):
+        op = rng.random()
+        if op < 0.15 or not live_machines:
+            i = len(live_machines)
+            for st in (st_n, st_p):
+                st.node_added(make_machine(i))
+            live_machines.append(generate_uuid(f"nm{i}"))
+        elif op < 0.55:
+            i = int(rng.integers(0, 10_000))
+            t = make_task(i, task_type=int(rng.integers(0, 4)))
+            for st in (st_n, st_p):
+                st.task_submitted(
+                    TaskInfo(uid=t.uid, job_id=t.job_id,
+                             cpu_request=t.cpu_request,
+                             ram_request=t.ram_request,
+                             task_type=t.task_type)
+                )
+            if t.uid not in live_tasks:
+                live_tasks.append(t.uid)
+        elif op < 0.7 and live_tasks:
+            uid = live_tasks[int(rng.integers(0, len(live_tasks)))]
+            target = (
+                live_machines[int(rng.integers(0, len(live_machines)))]
+                if rng.random() < 0.8 else None
+            )
+            for st in (st_n, st_p):
+                st.apply_placements([(uid, target)])
+        elif op < 0.8 and live_tasks:
+            uid = live_tasks.pop(int(rng.integers(0, len(live_tasks))))
+            for st in (st_n, st_p):
+                st.task_removed(uid)
+        elif op < 0.9 and live_tasks:
+            uid = live_tasks[int(rng.integers(0, len(live_tasks)))]
+            for st in (st_n, st_p):
+                st.task_completed(uid)
+        elif live_machines and rng.random() < 0.5:
+            uuid = live_machines[int(rng.integers(0, len(live_machines)))]
+            for st in (st_n, st_p):
+                st.node_failed(uuid)
+        elif live_machines:
+            uuid = live_machines.pop(
+                int(rng.integers(0, len(live_machines)))
+            )
+            for st in (st_n, st_p):
+                st.node_removed(uuid)
+
+        if step % 40 == 0 or step == 399:
+            for include_running in (False, True):
+                assert_views_equal(
+                    st_n.build_round_view(include_running),
+                    st_p.build_round_view(include_running),
+                )
+
+
+def test_planner_native_matches_python():
+    """Same workload through two planners (native vs pure state): same
+    objective and same placements."""
+    results = []
+    for use_native in (True, False):
+        st = ClusterState(use_native=use_native)
+        for i in range(6):
+            st.node_added(make_machine(i))
+        for i in range(30):
+            st.task_submitted(make_task(i))
+        planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+        _, m = planner.schedule_round()
+        placements = sorted(
+            (uid, t.scheduled_to) for uid, t in st.tasks.items()
+        )
+        results.append((m.objective, m.placed, placements))
+    assert results[0] == results[1]

@@ -1,0 +1,142 @@
+"""Pod-level affinity/anti-affinity with multi-round resolution
+(BASELINE config 3).
+
+Selectors are evaluated against the labels of tasks *running* on each
+machine, so affinity to a not-yet-placed pod resolves on a later round —
+the reference's roadmap semantics built on the contract extension
+(TaskDescriptor.pod_affinity/pod_anti_affinity).
+"""
+
+from poseidon_tpu.costmodel import get_cost_model
+from poseidon_tpu.glue import FakeKube, Node, Pod, Poseidon
+from poseidon_tpu.graph.instance import RoundPlanner
+from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+from poseidon_tpu.service import FirmamentTPUServer
+from poseidon_tpu.utils.config import PoseidonConfig
+from poseidon_tpu.utils.ids import generate_uuid
+
+IN_SET = 0
+
+
+def cluster(n=3, cpu=4000):
+    st = ClusterState()
+    for i in range(n):
+        st.node_added(
+            MachineInfo(uuid=generate_uuid(f"pa{i}"), cpu_capacity=cpu,
+                        ram_capacity=1 << 24)
+        )
+    return st
+
+
+def test_affinity_follows_target_across_rounds():
+    st = cluster()
+    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+    # Round 1: place the database pod.
+    st.task_submitted(
+        TaskInfo(uid=1, job_id="db", cpu_request=100, ram_request=1 << 18,
+                 labels={"app": "db"})
+    )
+    planner.schedule_round()
+    db_machine = st.tasks[1].scheduled_to
+    assert db_machine is not None
+
+    # Round 2: a web pod with affinity to app=db must land next to it.
+    st.task_submitted(
+        TaskInfo(uid=2, job_id="web", cpu_request=100, ram_request=1 << 18,
+                 labels={"app": "web"},
+                 pod_affinity=((IN_SET, "app", ("db",)),))
+    )
+    planner.schedule_round()
+    assert st.tasks[2].scheduled_to == db_machine
+
+
+def test_affinity_waits_until_target_runs():
+    st = cluster()
+    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+    # The dependent pod arrives FIRST: no machine hosts app=db yet, so it
+    # waits (multi-round resolution), then follows once the target runs.
+    st.task_submitted(
+        TaskInfo(uid=2, job_id="web", cpu_request=100, ram_request=1 << 18,
+                 pod_affinity=((IN_SET, "app", ("db",)),))
+    )
+    _, m1 = planner.schedule_round()
+    assert m1.placed == 0 and m1.unscheduled == 1
+
+    st.task_submitted(
+        TaskInfo(uid=1, job_id="db", cpu_request=100, ram_request=1 << 18,
+                 labels={"app": "db"})
+    )
+    planner.schedule_round()
+    _, m3 = planner.schedule_round()
+    assert st.tasks[2].scheduled_to == st.tasks[1].scheduled_to
+
+
+def test_anti_affinity_avoids_target():
+    st = cluster(n=2)
+    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+    st.task_submitted(
+        TaskInfo(uid=1, job_id="noisy", cpu_request=100,
+                 ram_request=1 << 18, labels={"class": "noisy"})
+    )
+    planner.schedule_round()
+    noisy_machine = st.tasks[1].scheduled_to
+
+    st.task_submitted(
+        TaskInfo(uid=2, job_id="quiet", cpu_request=100,
+                 ram_request=1 << 18,
+                 pod_anti_affinity=((IN_SET, "class", ("noisy",)),))
+    )
+    planner.schedule_round()
+    assert st.tasks[2].scheduled_to is not None
+    assert st.tasks[2].scheduled_to != noisy_machine
+
+
+def test_anti_self_spreads_one_per_machine():
+    st = cluster(n=3)
+    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+    # 4 replicas anti-affine to their own label on 3 machines: 3 spread
+    # out, the 4th waits.
+    for i in range(4):
+        st.task_submitted(
+            TaskInfo(uid=10 + i, job_id="spread", cpu_request=100,
+                     ram_request=1 << 18, labels={"app": "spread"},
+                     pod_anti_affinity=((IN_SET, "app", ("spread",)),))
+        )
+    _, m = planner.schedule_round()
+    assert m.placed == 3 and m.unscheduled == 1
+    machines = {
+        t.scheduled_to for t in st.tasks.values() if t.scheduled_to
+    }
+    assert len(machines) == 3
+
+
+def test_pod_affinity_over_the_wire():
+    kube = FakeKube()
+    for i in range(3):
+        kube.add_node(Node(name=f"n{i}", cpu_capacity=4000,
+                           ram_capacity=1 << 24))
+    with FirmamentTPUServer(address="127.0.0.1:0") as server:
+        cfg = PoseidonConfig(firmament_address=server.address,
+                             scheduling_interval=3600)
+        with Poseidon(kube, config=cfg, run_loop=False) as poseidon:
+            kube.create_pod(
+                Pod(name="db", cpu_request=100, ram_request=1 << 18,
+                    labels={"app": "db"})
+            )
+            assert poseidon.drain_watchers()
+            poseidon.schedule_once()
+            db_node = kube.pods["default/db"].node_name
+
+            kube.create_pod(
+                Pod(name="web", cpu_request=100, ram_request=1 << 18,
+                    pod_affinity={"app": "db"})
+            )
+            kube.create_pod(
+                Pod(name="loner", cpu_request=100, ram_request=1 << 18,
+                    pod_anti_affinity={"app": "db"})
+            )
+            assert poseidon.drain_watchers()
+            poseidon.schedule_once()
+            assert kube.pods["default/web"].node_name == db_node
+            loner_node = kube.pods["default/loner"].node_name
+            assert loner_node and loner_node != db_node

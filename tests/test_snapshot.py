@@ -1,0 +1,46 @@
+"""Checkpoint/restore of the scheduling state."""
+
+from poseidon_tpu.costmodel import get_cost_model
+from poseidon_tpu.graph.instance import RoundPlanner
+from poseidon_tpu.graph.snapshot import load_state, save_state
+from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+from poseidon_tpu.utils.ids import generate_uuid, task_uid
+
+
+def test_roundtrip_preserves_schedule(tmp_path):
+    st = ClusterState()
+    for i in range(4):
+        st.node_added(
+            MachineInfo(uuid=generate_uuid(f"sn{i}"), cpu_capacity=4000,
+                        ram_capacity=1 << 24, labels={"zone": f"z{i % 2}"})
+        )
+    st.node_failed(generate_uuid("sn3"))
+    for i in range(10):
+        st.task_submitted(
+            TaskInfo(uid=task_uid("sj", i), job_id="sj",
+                     cpu_request=250, ram_request=1 << 18,
+                     labels={"app": "x"})
+        )
+    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+    planner.schedule_round()
+    st.task_completed(task_uid("sj", 0))
+
+    path = tmp_path / "state.json"
+    save_state(st, path)
+    st2 = load_state(path)
+
+    assert st2.round_index == st.round_index
+    assert set(st2.machines) == set(st.machines)
+    assert not st2.machines[generate_uuid("sn3")].healthy
+    assert set(st2.tasks) == set(st.tasks)
+    for uid, t in st.tasks.items():
+        t2 = st2.tasks[uid]
+        assert t2.scheduled_to == t.scheduled_to
+        assert t2.state == t.state
+        assert t2.wait_rounds == t.wait_rounds
+        assert t2.ec_id == t.ec_id
+
+    # The restored state schedules on: a quiet world yields no deltas.
+    planner2 = RoundPlanner(st2, get_cost_model("cpu_mem"))
+    deltas, m = planner2.schedule_round()
+    assert deltas == [] and m.unscheduled == 0
