@@ -40,18 +40,15 @@ def _ensure_live_backend() -> None:
              "print(float((jnp.ones((64,64))@jnp.ones((64,64))).sum()))"],
             capture_output=True, text=True, timeout=150,
         )
-        ok = probe.returncode == 0 and "4096" in probe.stdout
+        # ones(64,64) @ ones(64,64) sums to 64**3 = 262144.
+        ok = probe.returncode == 0 and "262144" in probe.stdout
     except subprocess.TimeoutExpired:
         ok = False
     if ok:
         return
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["PYTHONPATH"] = os.pathsep.join(
-        [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-         if p and "axon" not in p] + [os.path.dirname(os.path.abspath(__file__))]
-    )
-    env["JAX_PLATFORMS"] = "cpu"
+    from poseidon_tpu.utils.envutil import clean_cpu_env
+
+    env = clean_cpu_env(os.path.dirname(os.path.abspath(__file__)))
     env["POSEIDON_BENCH_NO_PROBE"] = "1"
     print("# accelerator unreachable; falling back to CPU", file=sys.stderr)
     os.execve(sys.executable, [sys.executable] + sys.argv, env)

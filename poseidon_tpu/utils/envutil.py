@@ -1,0 +1,79 @@
+"""Subprocess environment helpers for backend probing and CPU fallback.
+
+The accelerator plugin's client construction can hang forever when its
+tunnel is dead — even with ``JAX_PLATFORMS=cpu`` set — so any process that
+must never hang (the bench, the driver entry points) probes the backend in
+a disposable subprocess and, on failure, re-runs on a plain-CPU
+environment built here: plugin site hooks stripped, virtual host devices
+forced when a mesh is needed.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional
+
+
+def clean_cpu_env(root: str, n_devices: Optional[int] = None) -> dict:
+    """Environment for a clean-CPU child process.
+
+    ``root`` is prepended to PYTHONPATH so the child resolves the repo
+    regardless of cwd/safe-path settings; ``n_devices`` forces a virtual
+    host-device count (for mesh work on CPU).
+    """
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+         if p and "axon" not in p] + [root]
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = [
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def probe_device_count(timeout: float = 120.0) -> int:
+    """Count the backend's devices from a disposable subprocess.
+
+    Returns -1 when the probe dies or times out (wedged tunnel, contended
+    exclusive accelerator) — distinct from a healthy backend that simply
+    has fewer devices than wanted.
+    """
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('NDEV=%d' % len(jax.devices()))"],
+            capture_output=True, text=True, timeout=timeout,
+        )
+        if probe.returncode == 0:
+            for line in probe.stdout.splitlines():
+                if line.startswith("NDEV="):
+                    return int(line.split("=", 1)[1])
+    except (subprocess.TimeoutExpired, ValueError):
+        pass
+    return -1
+
+
+def backend_initialized() -> bool:
+    """True iff THIS process already has a live jax backend.
+
+    Never triggers backend initialization itself (that is the hang being
+    avoided); reads jax's internal backend registry when jax is loaded.
+    """
+    jx = sys.modules.get("jax")
+    if jx is None:
+        return False
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
