@@ -21,11 +21,14 @@ firmament_scheduler.proto:15-45, delta vocabulary scheduling_delta.proto:24-40):
 from __future__ import annotations
 
 import enum
+import logging
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+log = logging.getLogger("poseidon_tpu.planner")
 
 from poseidon_tpu.costmodel.base import CostModel
 from poseidon_tpu.graph.state import ClusterState
@@ -67,6 +70,10 @@ class RoundMetrics:
     preempted: int = 0
     migrated: int = 0
     unscheduled: int = 0
+    # False when any band's solve exhausted its iteration budget even on a
+    # cold retry (gap_bound is then inf and the committed placement is the
+    # repaired feasible-but-suboptimal one).  Alarmed via log.error.
+    converged: bool = True
 
 
 @dataclass
@@ -232,6 +239,10 @@ class RoundPlanner:
             metrics.num_ecs = m.num_ecs
             metrics.num_machines = m.num_machines
             metrics.objective = m.objective
+            # The standing placement's certificate carries over verbatim:
+            # a quiet round after a non-converged one is still uncertified.
+            metrics.gap_bound = m.gap_bound
+            metrics.converged = m.converged
             st.round_index += 1
             metrics.total_seconds = time.perf_counter() - t0
             self.last_metrics = metrics
@@ -257,6 +268,17 @@ class RoundPlanner:
         t_solve = time.perf_counter()
         flows = self._solve_banded(ecs, mt, metrics)
         metrics.solve_seconds = time.perf_counter() - t_solve
+        if metrics.gap_bound == float("inf"):
+            # Even the cold retry exhausted its iteration budget: the
+            # committed placement is the repaired feasible one, with no
+            # optimality certificate.  This must never pass silently.
+            metrics.converged = False
+            log.error(
+                "schedule round %d did not converge: E=%d M=%d tasks=%d "
+                "(placements are repaired-feasible, optimality uncertified)",
+                metrics.round_index, metrics.num_ecs, metrics.num_machines,
+                metrics.num_tasks,
+            )
 
         deltas = self._assign(flows, view, metrics)
         st.round_index += 1
@@ -405,15 +427,25 @@ class RoundPlanner:
             )
 
         def run(costs, eps, p=None, f=None, u=None):
+            # Policy iteration budgets (the kernel default is a pure
+            # backstop): warm attempts get a tight cap — their failure
+            # mode is the cold retry below, so burning a long budget on a
+            # misled warm start only adds latency.  Cold solves get 4x
+            # the largest iteration count observed at 10k-machine scale
+            # (~8k), keeping worst-case device wall time under the TPU
+            # runtime watchdog.
+            is_warm = p is not None or f is not None
             return solve_transport(
                 costs, ecs_b.supply, col_cap, cm.unsched_cost, p,
                 arc_capacity=cm.arc_capacity, init_flows=f,
                 init_unsched=u, eps_start=eps,
+                max_iter_total=16384 if is_warm else 32768,
             )
 
         sol = run(cm.costs, eps_start, prices, flows0, unsched0)
-        if eps_start is not None and sol.gap_bound == float("inf"):
-            # Deep churn the drift heuristic missed: cold full ladder.
+        if prices is not None and sol.gap_bound == float("inf"):
+            # Any warm start can mislead (drift heuristic missed deep
+            # churn, or a poisoned carried frame): retry cold full ladder.
             sol = run(cm.costs, None)
 
         # Gang atomicity: forbid partially-placed gang rows, re-solve warm

@@ -59,6 +59,17 @@ INF_COST = 1 << 28
 _NEG = -(1 << 30)
 _POS = 1 << 30
 
+# Warm-start price hygiene: potentials only matter up to a uniform shift,
+# so returned prices are re-anchored at max=0, and incoming warm prices are
+# anchored then floor-clamped to this spread.  Without the clamp, nodes
+# that starved in a previous round carry potentials at/below the relabel
+# floor (_NEG // 2); such a node can never relabel again (the floor clamp
+# raises its candidate back), so it stays active forever and every phase
+# burns its full max_iter — a multi-minute device program that trips the
+# TPU runtime watchdog ("worker crashed").  Working costs are bounded by
+# 2**27 (choose_scale), so a 2**28 spread keeps all live structure.
+PRICE_SPREAD_CAP = 1 << 28
+
 
 def choose_scale(num_ecs: int, num_machines: int,
                  max_cost: int = COST_CAP) -> int:
@@ -118,8 +129,13 @@ def _relabel(rc, resid, cand, excess, p, eps):
     has_resid = resid > 0
     has_adm = jnp.any((rc < 0) & has_resid, axis=1)
     maxcand = jnp.max(jnp.where(has_resid, cand, _NEG), axis=1)
-    do = (excess > 0) & ~has_adm & (maxcand > _NEG // 2)
-    return jnp.where(do, jnp.maximum(maxcand - eps, _NEG // 2), p)
+    new_p = jnp.maximum(maxcand - eps, _NEG // 2)
+    # Only ever move DOWN: a node already at/below the floor would get its
+    # potential *raised* by the clamp, which breaks the strict-decrease
+    # invariant and can oscillate.  Such a node simply stays active until
+    # the iteration budget trips (detected as non-convergence).
+    do = (excess > 0) & ~has_adm & (maxcand > _NEG // 2) & (new_p < p)
+    return jnp.where(do, new_p, p)
 
 
 _DINF = 1 << 24  # "unreached" marker for global-update distances
@@ -203,9 +219,16 @@ def _global_update(F, Ffb, Fmt, pe, pm, pt, exc_e, exc_m, exc_t,
     # Converged and overflow-safe => apply; otherwise keep the old
     # potentials (the update is only an accelerator, skipping is sound).
     ok = ~changed & (finite_max < (1 << 26) // jnp.maximum(eps, 1))
-    pe_new = jnp.where(ok, pe - eps * d_e, pe)
-    pm_new = jnp.where(ok, pm - eps * d_m, pm)
-    pt_new = jnp.where(ok, pt - eps * d_t, pt)
+    # The _NEG // 2 floor keeps int32 arithmetic safe: unreached (typically
+    # structurally dead) nodes move down by dbig on every applied update and
+    # would otherwise drift toward overflow across a long solve.  Clamping a
+    # node that a *live* node holds a residual arc to can locally break
+    # eps-optimality — that is tolerated here because optimality is not
+    # assumed from the invariant: _host_finalize re-derives the certificate
+    # from the final state's actual reduced costs.
+    pe_new = jnp.where(ok, jnp.maximum(pe - eps * d_e, _NEG // 2), pe)
+    pm_new = jnp.where(ok, jnp.maximum(pm - eps * d_m, _NEG // 2), pm)
+    pt_new = jnp.where(ok, jnp.maximum(pt - eps * d_t, _NEG // 2), pt)
     return pe_new, pm_new, pt_new
 
 
@@ -253,9 +276,26 @@ def _arc_tensors(F, Ffb, Fmt, pe, pm, pt, *, C, U, Uem, supply, cap,
     return ec, m, t
 
 
-def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, J, max_iter):
+def _excesses(F, Ffb, Fmt, *, supply, total):
+    """Node excesses from the flow state — the single source of truth for
+    both the phase loop's termination condition and the device-side
+    convergence certificate."""
+    exc_e = supply - jnp.sum(F, axis=1) - Ffb
+    exc_m = jnp.sum(F, axis=0) - Fmt
+    exc_t = jnp.sum(Fmt) + jnp.sum(Ffb) - total
+    return exc_e, exc_m, exc_t
+
+
+def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, J, max_iter,
+              max_iter_total):
     """One epsilon phase: refine the carried flows to the new eps, then
-    synchronous push/relabel until every excess is zero."""
+    synchronous push/relabel until every excess is zero.
+
+    ``max_iter_total`` bounds the iterations summed over ALL phases: a
+    pathological instance then returns promptly as non-converged (the host
+    repairs it and the planner retries cold) instead of running the device
+    program long enough to trip the TPU runtime watchdog.
+    """
     E, M = C.shape
     admissible_arcs = C < INF_COST
     (F_in, Ffb_in, Fmt_in, pe, pm, pt, total_iters) = carry
@@ -270,8 +310,15 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, J, max_iter):
     # reverse residual (else empty); anything in [-eps, eps] keeps its flow.
     # This preserves the warm assignment across phases/rounds instead of the
     # full-saturation shuffle, which at scale dwarfs the actual solve. ---
+    # Once the cross-phase budget is exhausted the loop below runs zero
+    # iterations, so the refine must not fire either: it would saturate /
+    # empty arcs with nothing left to repair the resulting excesses,
+    # mangling the best-so-far state the host repair then works from.
+    budget_left = total_iters < max_iter_total
+
     def refine(rc, flow, hi):
-        return jnp.where(rc < -eps, hi, jnp.where(rc > eps, 0, flow))
+        ref = jnp.where(rc < -eps, hi, jnp.where(rc > eps, 0, flow))
+        return jnp.where(budget_left, ref, flow)
 
     rc_em = jnp.where(admissible_arcs, C + pe[:, None] - pm[None, :], _POS)
     F = refine(rc_em, F_in, Uem)
@@ -279,16 +326,17 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, J, max_iter):
     Fmt = refine(pm - pt, Fmt_in, cap)
 
     def excesses(F, Ffb, Fmt):
-        exc_e = supply - jnp.sum(F, axis=1) - Ffb
-        exc_m = jnp.sum(F, axis=0) - Fmt
-        exc_t = jnp.sum(Fmt) + jnp.sum(Ffb) - total
-        return exc_e, exc_m, exc_t
+        return _excesses(F, Ffb, Fmt, supply=supply, total=total)
 
     def cond(st):
         _F, _Ffb, _Fmt, exc, _pe, _pm, _pt, it = st
         exc_e, exc_m, exc_t = exc
         active = jnp.any(exc_e > 0) | jnp.any(exc_m > 0) | (exc_t > 0)
-        return (it < max_iter) & active
+        return (
+            (it < max_iter)
+            & (total_iters + it < max_iter_total)
+            & active
+        )
 
     def body(st):
         F, Ffb, Fmt, exc, pe, pm, pt, it = st
@@ -342,7 +390,8 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, J, max_iter):
 
 @functools.partial(jax.jit, static_argnames=("J", "max_iter", "scale"))
 def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
-                  init_flows, init_fb, eps_sched, *, J, max_iter, scale):
+                  init_flows, init_fb, eps_sched, max_iter_total, *, J,
+                  max_iter, scale):
     """The jitted solve.  All inputs int32; shapes static.
 
     costs: [E, M] raw costs (INF_COST where inadmissible)
@@ -353,6 +402,13 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
     init_flows/init_fb: warm-start assignment (zeros for a cold solve); the
       phase refinement step keeps whatever part of it is still eps-optimal
     eps_sched: [num_phases] epsilon schedule, descending to 1
+    max_iter_total: scalar int32, traced (budgets differ warm vs cold and
+      must not mint separate compile keys)
+
+    Returns ``(F, Ffb, prices, iters, clean)``: ``clean`` is True iff the
+    final state has zero excess everywhere — the exact device-side
+    convergence certificate (budget exhaustion can leave states that look
+    feasible to host-side repair checks yet aborted mid-ladder).
     """
     E, M = costs.shape
     C = jnp.where(costs >= INF_COST, INF_COST, costs * scale).astype(jnp.int32)
@@ -384,12 +440,16 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
 
     phase = functools.partial(
         _pr_phase, C=C, U=U, Uem=Uem, supply=supply, cap=cap, total=total,
-        J=J, max_iter=max_iter,
+        J=J, max_iter=max_iter, max_iter_total=max_iter_total,
     )
     carry0 = (F0, Ffb0, Fmt0, pe, pm, pt, jnp.int32(0))
     (F, Ffb, Fmt, pe, pm, pt, iters), _ = lax.scan(phase, carry0, eps_sched)
     prices = jnp.concatenate([pe, pm, pt[None]])
-    return F, Ffb, prices, iters
+    exc_e, exc_m, exc_t = _excesses(F, Ffb, Fmt, supply=supply, total=total)
+    clean = (
+        jnp.all(exc_e == 0) & jnp.all(exc_m == 0) & (exc_t == 0)
+    )
+    return F, Ffb, prices, iters, clean
 
 
 # The epsilon ladder always has this many phases: values are traced (no
@@ -437,10 +497,83 @@ def _host_validate(costs, supply, capacity, unsched_cost, scale, eps_start):
     return scale, eps_sched
 
 
+def normalize_prices(p: np.ndarray) -> np.ndarray:
+    """Anchor potentials at max=0 and floor the spread.
+
+    Potentials only matter up to a uniform shift, so the anchor preserves
+    every reduced cost exactly; the floor clamp bounds the spread a warm
+    start can inject (see PRICE_SPREAD_CAP).  Applied to every returned
+    price vector (so cross-round drift cannot accumulate) and to every
+    incoming warm start (so frames produced before this invariant existed
+    are still safe).
+    """
+    p = np.asarray(p, dtype=np.int32)
+    if p.size == 0:
+        return p
+    shifted = p.astype(np.int64) - int(p.max())
+    return np.maximum(shifted, -PRICE_SPREAD_CAP).astype(np.int32)
+
+
+def _certified_eps(flows, unsched, prices, *, costs, supply, capacity,
+                   unsched_cost, scale, arc_capacity=None):
+    """Smallest eps for which the final state is verifiably eps-optimal.
+
+    Recomputed on host from the actual residual reduced costs, so the
+    optimality certificate never *assumes* the kernel's invariants held —
+    the relabel/global-update floor clamps can locally break
+    eps-optimality in pathological states, and this check is what keeps
+    gap_bound honest regardless.  O(E*M) numpy, trivial next to the solve.
+    """
+    E, M = costs.shape
+    C = costs.astype(np.int64) * scale
+    pe = prices[:E].astype(np.int64)
+    pm = prices[E:E + M].astype(np.int64)
+    pt = int(prices[E + M])
+    adm = costs < INF_COST
+    rc = C + pe[:, None] - pm[None, :]
+    Uem = np.minimum(supply.astype(np.int64)[:, None],
+                     capacity.astype(np.int64)[None, :])
+    if arc_capacity is not None:
+        Uem = np.minimum(Uem, arc_capacity.astype(np.int64))
+    fl = flows.astype(np.int64)
+    worst = 0
+    fwd = adm & (Uem - fl > 0)
+    if fwd.any():
+        worst = max(worst, int(-(rc[fwd].min(initial=0))))
+    rev = adm & (fl > 0)
+    if rev.any():
+        worst = max(worst, int(rc[rev].max(initial=0)))
+    rc_fb = unsched_cost.astype(np.int64) * scale + pe - pt
+    # Fallback forward residual: supply - Ffb; Ffb == unsched here.
+    fb_resid = supply.astype(np.int64) - unsched.astype(np.int64) > 0
+    if fb_resid.any():
+        worst = max(worst, int(-(rc_fb[fb_resid].min(initial=0))))
+    fb_loaded = unsched > 0
+    if fb_loaded.any():
+        worst = max(worst, int(rc_fb[fb_loaded].max(initial=0)))
+    # Machine->sink arcs (cost 0): Fmt == column sum at a clean exit.
+    fmt = fl.sum(axis=0)
+    rc_mt = pm - pt
+    mt_resid = capacity.astype(np.int64) - fmt > 0
+    if mt_resid.any():
+        worst = max(worst, int(-(rc_mt[mt_resid].min(initial=0))))
+    mt_loaded = fmt > 0
+    if mt_loaded.any():
+        worst = max(worst, int(rc_mt[mt_loaded].max(initial=0)))
+    return max(1, worst)
+
+
 def _host_finalize(flows, unsched, prices, iters, *,
                    costs, supply, capacity, unsched_cost,
-                   scale) -> TransportSolution:
-    """Device results -> repaired, certified TransportSolution (host side)."""
+                   scale, clean=True, arc_capacity=None) -> TransportSolution:
+    """Device results -> repaired, certified TransportSolution (host side).
+
+    ``clean`` is the device's own convergence certificate (zero excess at
+    exit).  The feasibility repairs below are still needed — the returned
+    arrays must be safe to commit — but they are NOT the convergence
+    signal: an iteration-budget abort can leave a host-feasible state that
+    only the device flag exposes.
+    """
     E, M = costs.shape
     flows = np.asarray(flows)
     unsched = np.asarray(unsched)
@@ -448,7 +581,7 @@ def _host_finalize(flows, unsched, prices, iters, *,
     # Detect max_iter exhaustion: the returned state may then violate
     # conservation or capacity.  Repair to a feasible (suboptimal) solution
     # and report an unbounded gap instead of silently claiming exactness.
-    converged = True
+    converged = bool(clean)
     over_cap = flows.sum(axis=0) - capacity
     if (over_cap > 0).any():
         converged = False
@@ -487,11 +620,21 @@ def _host_finalize(flows, unsched, prices, iters, *,
     if not converged:
         gap_bound = float("inf")
     else:
-        gap_bound = 0.0 if scale > n else n / float(scale)
+        eps_actual = _certified_eps(
+            flows, unsched, np.asarray(prices), costs=costs, supply=supply,
+            capacity=capacity, unsched_cost=unsched_cost, scale=scale,
+            arc_capacity=arc_capacity,
+        )
+        if eps_actual <= 1:
+            gap_bound = 0.0 if scale > n else n / float(scale)
+        else:
+            # A floor clamp perturbed eps-optimality somewhere: still a
+            # certified bound, just looser (cost <= opt + n * eps).
+            gap_bound = n * eps_actual / float(scale)
     return TransportSolution(
         flows=flows,
         unsched=unsched,
-        prices=np.asarray(prices),
+        prices=normalize_prices(prices),
         objective=objective,
         gap_bound=gap_bound,
         iterations=int(iters),
@@ -511,6 +654,7 @@ def solve_transport(
     eps_start: Optional[int] = None,
     bid_ranks: int = 8,
     max_iter_per_phase: int = 8192,
+    max_iter_total: Optional[int] = None,
     scale: Optional[int] = None,
 ) -> TransportSolution:
     """Solve the EC->machine transportation problem on device.
@@ -518,6 +662,14 @@ def solve_transport(
     Every unit of supply ends up either on a machine or on the per-EC
     unscheduled fallback arc, so the instance is always feasible and this
     computes a true min-cost max-flow of the Firmament network.
+
+    ``max_iter_total`` bounds the iterations summed over all epsilon
+    phases, capping the device program's worst-case wall time (a runaway
+    kernel trips the TPU runtime watchdog and kills the worker).
+    Exhaustion returns a repaired-feasible solution with
+    ``gap_bound = inf``.  The default (``NUM_PHASES * max_iter_per_phase``)
+    never binds before the per-phase caps do — callers with latency
+    budgets (the round planner) pass a tighter policy value.
     """
     costs = np.asarray(costs, dtype=np.int32)
     supply = np.asarray(supply, dtype=np.int32)
@@ -557,7 +709,9 @@ def solve_transport(
     )
     prices_p = np.zeros(E_pad + M + 1, dtype=np.int32)
     if init_prices is not None:
-        init_prices = np.asarray(init_prices, dtype=np.int32)
+        # Normalized warm prices are <= 0 with max 0, so the zero-filled
+        # padded rows sit exactly at the anchor and stay inert.
+        init_prices = normalize_prices(init_prices)
         prices_p[:E] = init_prices[:E]
         prices_p[E_pad:] = init_prices[E:]
 
@@ -577,13 +731,16 @@ def solve_transport(
         arc_p[:E] = arc_capacity
     arc_p[E:] = 0
 
-    flows, unsched, prices, iters = _solve_device(
+    if max_iter_total is None:
+        max_iter_total = NUM_PHASES * max_iter_per_phase
+    flows, unsched, prices, iters, clean = _solve_device(
         jnp.asarray(costs_p), jnp.asarray(supply_p), jnp.asarray(capacity),
         jnp.asarray(unsched_p), jnp.asarray(arc_p),
         jnp.asarray(prices_p),
         jnp.asarray(flows_p),
         jnp.asarray(fb_p),
         jnp.asarray(eps_sched),
+        jnp.int32(max_iter_total),
         J=J, max_iter=max_iter_per_phase, scale=int(scale),
     )
     flows = np.asarray(flows)[:E]
@@ -593,5 +750,6 @@ def solve_transport(
     return _host_finalize(
         flows, unsched, prices_out, iters,
         costs=costs, supply=supply, capacity=capacity,
-        unsched_cost=unsched_cost, scale=scale,
+        unsched_cost=unsched_cost, scale=scale, clean=clean,
+        arc_capacity=arc_capacity,
     )

@@ -76,6 +76,7 @@ def solve_transport_sharded(
     eps_start: Optional[int] = None,
     bid_ranks: int = 8,
     max_iter_per_phase: int = 8192,
+    max_iter_total: Optional[int] = None,
     scale: Optional[int] = None,
 ) -> TransportSolution:
     """Drop-in mesh-sharded variant of ``transport.solve_transport``.
@@ -99,7 +100,7 @@ def solve_transport_sharded(
             arc_capacity=arc_capacity, init_flows=init_flows,
             init_unsched=init_unsched, eps_start=eps_start,
             bid_ranks=bid_ranks, max_iter_per_phase=max_iter_per_phase,
-            scale=scale,
+            max_iter_total=max_iter_total, scale=scale,
         )
 
     # Pad machines to a mesh multiple and EC rows to a power of two (the
@@ -130,7 +131,9 @@ def solve_transport_sharded(
         fb_p[:E] = init_unsched
     prices_p = np.zeros(e_pad + m_pad + 1, dtype=np.int32)
     if init_prices is not None:
-        init_prices = np.asarray(init_prices, dtype=np.int32)
+        # Same warm-start hygiene as the single-chip wrapper: anchored at
+        # max=0 with the spread floor-clamped (see PRICE_SPREAD_CAP).
+        init_prices = transport.normalize_prices(init_prices)
         prices_p[:E] = init_prices[:E]
         prices_p[e_pad : e_pad + M] = init_prices[E : E + M]
         prices_p[e_pad + m_pad] = init_prices[E + M]
@@ -144,8 +147,10 @@ def solve_transport_sharded(
     repl = NamedSharding(mesh, P())                    # replicated
 
     J = max(2, min(bid_ranks, m_pad + 1))
+    if max_iter_total is None:
+        max_iter_total = transport.NUM_PHASES * max_iter_per_phase
     put = jax.device_put
-    flows, unsched, prices, iters = _solve_device(
+    flows, unsched, prices, iters, clean = _solve_device(
         put(jnp.asarray(costs_p), col),
         put(jnp.asarray(supply_p), repl),
         put(jnp.asarray(capacity_p), vec_m),
@@ -157,6 +162,7 @@ def solve_transport_sharded(
         put(jnp.asarray(flows_p), col),
         put(jnp.asarray(fb_p), repl),
         put(jnp.asarray(eps_sched), repl),
+        put(jnp.int32(max_iter_total), repl),
         J=J, max_iter=max_iter_per_phase, scale=int(scale),
     )
 
@@ -170,5 +176,6 @@ def solve_transport_sharded(
     return _host_finalize(
         flows, unsched, prices_out, iters,
         costs=costs, supply=supply, capacity=capacity,
-        unsched_cost=unsched_cost, scale=scale,
+        unsched_cost=unsched_cost, scale=scale, clean=clean,
+        arc_capacity=arc_capacity,
     )

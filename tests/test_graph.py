@@ -211,3 +211,56 @@ class TestRoundPlanner:
         deltas, metrics = self._planner(st).schedule_round()
         assert metrics.placed == 1
         assert deltas[0].resource_id == "m-1"
+
+    def test_nonconvergence_alarm_fires(self, caplog, monkeypatch):
+        """A round whose solve exhausts the iteration budget (gap inf even
+        after the cold retry) must flag converged=False and log.error —
+        silent non-convergence was round-2 Weak #5."""
+        import logging
+
+        from poseidon_tpu.graph import instance as inst
+        from poseidon_tpu.ops.transport import TransportSolution
+
+        def exhausted(costs, supply, capacity, unsched_cost, *a, **kw):
+            E, M = np.asarray(costs).shape
+            return TransportSolution(
+                flows=np.zeros((E, M), dtype=np.int32),
+                unsched=np.asarray(supply, dtype=np.int32).copy(),
+                prices=np.zeros(E + M + 1, dtype=np.int32),
+                objective=0,
+                gap_bound=float("inf"),
+                iterations=123,
+            )
+
+        monkeypatch.setattr(inst, "solve_transport", exhausted)
+        st = ClusterState()
+        st.node_added(mk_machine("m-0"))
+        st.task_submitted(mk_task(1))
+        planner = self._planner(st)
+        with caplog.at_level(logging.ERROR, "poseidon_tpu.planner"):
+            _, metrics = planner.schedule_round()
+        assert metrics.converged is False
+        assert any(
+            "did not converge" in r.message for r in caplog.records
+        )
+
+    def test_forced_exhaustion_returns_inf_gap(self):
+        """Driving the real kernel with a starved iteration budget yields a
+        repaired-feasible solution with an unbounded gap, not garbage."""
+        from poseidon_tpu.ops.transport import solve_transport
+
+        rng = np.random.default_rng(3)
+        costs = rng.integers(0, 100, size=(6, 8)).astype(np.int32)
+        supply = rng.integers(1, 6, size=6).astype(np.int32)
+        cap = rng.integers(1, 4, size=8).astype(np.int32)
+        unsched = np.full(6, 200, dtype=np.int32)
+        sol = solve_transport(
+            costs, supply, cap, unsched, max_iter_per_phase=1
+        )
+        assert sol.gap_bound == float("inf")
+        # Still feasible after host repair.
+        assert (sol.flows >= 0).all()
+        assert (sol.flows.sum(axis=0) <= cap).all()
+        np.testing.assert_array_equal(
+            sol.flows.sum(axis=1) + sol.unsched, supply
+        )

@@ -235,3 +235,49 @@ def test_choose_scale_bounds():
     assert choose_scale(4, 4) == 12
     big = choose_scale(256, 100_000)
     assert big * 4 * COST_CAP <= (1 << 30)
+
+
+def test_normalize_prices_anchor_and_clamp():
+    from poseidon_tpu.ops.transport import PRICE_SPREAD_CAP, normalize_prices
+
+    p = np.array([-(1 << 30) // 2 - 100_000_000, -5, 7], dtype=np.int32)
+    out = normalize_prices(p)
+    assert out.max() == 0
+    assert out.min() == -PRICE_SPREAD_CAP  # deep outlier floored
+    # A healthy spread is only shifted, never distorted.
+    q = np.array([-300, -200, -100], dtype=np.int32)
+    np.testing.assert_array_equal(
+        normalize_prices(q), np.array([-200, -100, 0], dtype=np.int32)
+    )
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_poisoned_warm_prices_still_converge(seed):
+    """Warm frames that pre-date the price-hygiene invariant can carry
+    potentials at/below the relabel floor; such a node could never relabel
+    again and the solve livelocked to the iteration budget (the round-2
+    TPU-worker 'crash' at 10k/100k).  The entry normalization must make
+    these solves terminate AND still land on the oracle optimum."""
+    rng = np.random.default_rng(900 + seed)
+    E, M = 6, 8
+    costs, supply, cap, unsched = random_instance(rng, E, M)
+    # Poisoned potentials: huge negative magnitudes straddling the floor.
+    poisoned = (
+        -rng.integers(1 << 28, 1 << 30, size=E + M + 1)
+    ).astype(np.int64).astype(np.int32)
+    sol = solve_transport(
+        costs, supply, cap, unsched, init_prices=poisoned,
+    )
+    check_solution_feasible(sol, costs, supply, cap)
+    expected = oracle.transport_objective(costs, supply, cap, unsched)
+    assert sol.objective == expected
+    # Returned prices are re-anchored: bounded spread, max at 0.
+    assert sol.prices.max() == 0
+    assert sol.prices.min() >= -(1 << 28)
+
+
+def test_returned_prices_are_anchored():
+    rng = np.random.default_rng(77)
+    costs, supply, cap, unsched = random_instance(rng, 5, 7)
+    sol = solve_transport(costs, supply, cap, unsched)
+    assert sol.prices.max() == 0
