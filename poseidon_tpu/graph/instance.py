@@ -259,6 +259,11 @@ class RoundPlanner:
             st.round_index += 1
             self._last_generation = st.generation
             self._last_unscheduled = 0
+            # Nothing solved, so the standing placement's certificate (set
+            # by the last real solve) carries over here too — a mutation
+            # that adds no pending work must not launder converged=False.
+            metrics.gap_bound = self.last_metrics.gap_bound
+            metrics.converged = self.last_metrics.converged
             metrics.total_seconds = time.perf_counter() - t0
             self.last_metrics = metrics
             return [], metrics

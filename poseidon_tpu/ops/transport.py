@@ -310,11 +310,13 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, J, max_iter,
     # reverse residual (else empty); anything in [-eps, eps] keeps its flow.
     # This preserves the warm assignment across phases/rounds instead of the
     # full-saturation shuffle, which at scale dwarfs the actual solve. ---
-    # Once the cross-phase budget is exhausted the loop below runs zero
-    # iterations, so the refine must not fire either: it would saturate /
-    # empty arcs with nothing left to repair the resulting excesses,
-    # mangling the best-so-far state the host repair then works from.
-    budget_left = total_iters < max_iter_total
+    # Once the cross-phase budget is (nearly) exhausted the loop below has
+    # no meaningful iterations left, so the refine must not fire either:
+    # it would saturate / empty arcs with nothing left to repair the
+    # resulting excesses, mangling the best-so-far state the host repair
+    # then works from.  64 iterations is a minimum repair allowance — a
+    # refine it cannot follow up on is worse than no refine.
+    budget_left = total_iters + 64 < max_iter_total
 
     def refine(rc, flow, hi):
         ref = jnp.where(rc < -eps, hi, jnp.where(rc > eps, 0, flow))
