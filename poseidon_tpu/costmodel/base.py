@@ -126,6 +126,15 @@ class CostModel:
     def build(self, ecs: ECTable, machines: MachineTable) -> CostMatrices:
         raise NotImplementedError
 
+    def max_cost(self) -> int:
+        """Static upper bound on every finite cost this model can emit.
+
+        The solver derives its (compile-key) cost scale from this bound
+        instead of the instance's observed maximum, so per-round drift in
+        the actual cost range cannot mint fresh XLA compiles.  Every
+        bundled model clips its outputs within 8x NORMALIZED_COST."""
+        return 8 * NORMALIZED_COST
+
 
 _REGISTRY: Dict[str, type] = {}
 

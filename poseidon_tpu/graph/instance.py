@@ -195,10 +195,31 @@ class RoundPlanner:
         preemption: bool = True,
         incremental: bool = True,
         reschedule_running: bool = False,
+        gang_scheduling: bool = True,
+        pod_affinity: bool = True,
+        solver_devices: int = 1,
+        flow_solver: str = "auction",
     ) -> None:
         self.state = state
         self.cost_model = cost_model
         self.preemption = preemption
+        # Feature toggles (FirmamentTPUConfig.gang_scheduling /
+        # .pod_affinity): tasks opt in via labels, these gates disable the
+        # machinery wholesale (gang repair re-solves; affinity multi-round
+        # cost terms) as a latency/behavior knob.
+        self.gang_scheduling = gang_scheduling
+        self.pod_affinity = pod_affinity
+        # flow_solver: "auction" = the TPU cost-scaling push-relabel
+        # kernel; "ssp" = the host successive-shortest-path verification
+        # solver (exact, slow, no device — the cs2-vs-flowlessly analog,
+        # FirmamentTPUConfig.flow_solver).
+        if flow_solver not in ("auction", "ssp"):
+            raise ValueError(f"unknown flow_solver {flow_solver!r}")
+        self.flow_solver = flow_solver
+        # solver_devices > 1: machine-axis mesh sharding over ICI
+        # (ops/transport_sharded.py); the mesh is built on first use.
+        self.solver_devices = solver_devices
+        self._mesh = None
         # reschedule_running=False (default, reference semantics): RUNNING
         # tasks hold reservations and stay put; each round solves only the
         # pending work — stable placements, small solves.  True re-enters
@@ -216,6 +237,89 @@ class RoundPlanner:
         self._last_generation = -1
         self._last_unscheduled = 1  # force a solve on the first round
         self.last_metrics = RoundMetrics()
+
+    # ---------------------------------------------------------------- solving
+
+    def _dispatch_solve(self, costs, supply, capacity, unsched_cost,
+                        prices=None, **kw):
+        """The one solver dispatch (rounds AND precompile go through it):
+        host ssp, mesh-sharded, or single-chip auction per config."""
+        if self.flow_solver == "ssp":
+            from poseidon_tpu.ops.transport import TransportSolution
+            from poseidon_tpu.solver.oracle import transport_solve
+
+            obj, flows, unsched = transport_solve(
+                costs, supply, capacity, unsched_cost,
+                arc_capacity=kw.get("arc_capacity"),
+            )
+            E_b, M_b = np.asarray(costs).shape
+            return TransportSolution(
+                flows=flows, unsched=unsched,
+                prices=np.zeros(E_b + M_b + 1, dtype=np.int32),
+                objective=obj, gap_bound=0.0, iterations=0,
+            )
+        if self.solver_devices > 1:
+            from poseidon_tpu.ops.transport_sharded import (
+                make_solver_mesh,
+                solve_transport_sharded,
+            )
+
+            if self._mesh is None:
+                self._mesh = make_solver_mesh(self.solver_devices)
+            return solve_transport_sharded(
+                costs, supply, capacity, unsched_cost, prices,
+                mesh=self._mesh, **kw,
+            )
+        return solve_transport(
+            costs, supply, capacity, unsched_cost, prices, **kw
+        )
+
+    def precompile(self, max_ecs: int = 256,
+                   max_machines: int = 0) -> int:
+        """Compile the solver ladder ahead of traffic.
+
+        One synthetic solve per EC-row bucket (8, 16, ... up to
+        ``max_ecs``) at the machine-count bucket of the CURRENT cluster —
+        plus, when ``max_machines`` exceeds it, at that expected-growth
+        bucket too — covering every compile key (padded shape + scale)
+        churn rounds can produce, so no round pays first-compile latency.
+        Goes through ``_dispatch_solve``, so the compiled kernel is the
+        configured one (sharded mesh included; ssp compiles nothing).
+        The scale matches production because both derive from the cost
+        model's static bound (max_cost_hint).  Returns the number of
+        shapes compiled.
+        """
+        from poseidon_tpu.ops.transport import bucket_size, padded_shape
+
+        if self.flow_solver == "ssp":
+            return 0
+        m_now = len(self.state.machines)
+        m_buckets = sorted({
+            bucket_size(m) for m in (m_now, max_machines) if m > 0
+        })
+        hint = self.cost_model.max_cost()
+        rng = np.random.default_rng(0)
+        compiled = 0
+        e_cap, _ = padded_shape(max(max_ecs, 1), 1)
+        for m_bucket in m_buckets:
+            e_bucket = 8
+            while e_bucket <= e_cap:
+                costs = rng.integers(
+                    0, hint + 1, size=(e_bucket, m_bucket)
+                ).astype(np.int32)
+                supply = np.ones(e_bucket, dtype=np.int32)
+                cap = np.ones(m_bucket, dtype=np.int32)
+                unsched = np.full(e_bucket, hint, dtype=np.int32)
+                arc = np.ones((e_bucket, m_bucket), dtype=np.int32)
+                # Budgets are traced operands, not compile keys: one
+                # solve covers both the warm and cold paths per shape.
+                self._dispatch_solve(
+                    costs, supply, cap, unsched, arc_capacity=arc,
+                    max_cost_hint=hint,
+                )
+                compiled += 1
+                e_bucket *= 2
+        return compiled
 
     # ------------------------------------------------------------------ round
 
@@ -250,6 +354,11 @@ class RoundPlanner:
 
         view = st.build_round_view(include_running=self.reschedule_running)
         ecs, mt = view.ecs, view.machines
+        if not self.pod_affinity:
+            # Feature gate: drop the pod-(anti-)affinity vocabulary before
+            # the cost models see it (they key on these being non-None).
+            ecs.pod_affinity = None
+            ecs.pod_anti_affinity = None
         metrics = RoundMetrics(
             round_index=st.round_index,
             num_tasks=int(ecs.supply.sum()),
@@ -428,7 +537,9 @@ class RoundPlanner:
         eps_start = None
         if self.incremental and full_overlap and prev_costs is not None:
             eps_start = self._incremental_eps(
-                cm.costs, prev_costs, cm.unsched_cost, prev_unsched, prices
+                cm.costs, prev_costs, cm.unsched_cost, prev_unsched, prices,
+                self.cost_model.max_cost(),
+                mesh_multiple=max(self.solver_devices, 1),
             )
 
         def run(costs, eps, p=None, f=None, u=None):
@@ -440,11 +551,14 @@ class RoundPlanner:
             # (~8k), keeping worst-case device wall time under the TPU
             # runtime watchdog.
             is_warm = p is not None or f is not None
-            return solve_transport(
+            return self._dispatch_solve(
                 costs, ecs_b.supply, col_cap, cm.unsched_cost, p,
                 arc_capacity=cm.arc_capacity, init_flows=f,
                 init_unsched=u, eps_start=eps,
                 max_iter_total=16384 if is_warm else 32768,
+                # The model's static bound pins the cost scale (a compile
+                # key) regardless of per-round cost drift.
+                max_cost_hint=self.cost_model.max_cost(),
             )
 
         sol = run(cm.costs, eps_start, prices, flows0, unsched0)
@@ -456,7 +570,11 @@ class RoundPlanner:
         # Gang atomicity: forbid partially-placed gang rows, re-solve warm
         # (each pass permanently forbids >= 1 row, so this terminates).
         effective_costs = cm.costs
-        if ecs_b.is_gang is not None and ecs_b.is_gang.any():
+        if (
+            self.gang_scheduling
+            and ecs_b.is_gang is not None
+            and ecs_b.is_gang.any()
+        ):
             for _ in range(int(ecs_b.is_gang.sum())):
                 placed = sol.flows.sum(axis=1)
                 partial = (
@@ -550,6 +668,8 @@ class RoundPlanner:
         unsched_cost: np.ndarray,
         prev_unsched_cost: np.ndarray,
         prices: Optional[np.ndarray],
+        max_cost_hint: int = 0,
+        mesh_multiple: int = 1,
     ):
         """Epsilon ladder start from the observed cost change under the
         carried prices.
@@ -568,6 +688,7 @@ class RoundPlanner:
             COST_CAP,
             INF_COST,
             choose_scale,
+            padded_shape,
         )
 
         now_inadm = costs >= INF_COST
@@ -592,13 +713,18 @@ class RoundPlanner:
         )
         E, M = costs.shape
         # Reproduce the solver's scale derivation exactly (it pads rows to
-        # a power of two and quantizes the cost bound; _host_validate).
-        e_pad = max(8, 1 << (E - 1).bit_length())
+        # a power of two, columns to a quarter-octave bucket — rounded up
+        # to a mesh multiple on the sharded path — and quantizes the cost
+        # bound; _host_validate / padded_shape / transport_sharded).
+        e_pad, m_pad = padded_shape(E, M)
+        if mesh_multiple > 1:
+            m_pad = -(-m_pad // mesh_multiple) * mesh_multiple
         finite_max = int(costs[~now_inadm].max()) if (~now_inadm).any() else 0
-        max_raw = max(finite_max, int(unsched_cost.max(initial=0)), 1)
+        max_raw = max(finite_max, int(unsched_cost.max(initial=0)),
+                      max_cost_hint, 1)
         max_raw_q = 1 << (max_raw - 1).bit_length() if max_raw > 1 else 1
         max_raw_q = min(max_raw_q, COST_CAP)
-        scale = choose_scale(e_pad, M, max_raw_q)
+        scale = choose_scale(e_pad, m_pad, max_raw_q)
 
         eps = drift * scale + 1
         if fresh.any():

@@ -71,6 +71,39 @@ _POS = 1 << 30
 PRICE_SPREAD_CAP = 1 << 28
 
 
+def bucket_size(n: int, lo: int = 32) -> int:
+    """Quarter-octave geometric bucket for a padded axis extent.
+
+    Array shapes are XLA compile keys, so per-round churn in EC/machine
+    counts must land on a small fixed set of padded sizes or every round
+    mints a fresh multi-second compile (the round-2 churn storm: 50.8 s
+    churn vs 1.9 s wave at 4k machines).  Powers of two up to 256, then
+    {1.25, 1.5, 1.75, 2} x 2^k — worst-case 25% padding waste above 256,
+    and a count must move a quarter-octave to change shape.
+    """
+    if n <= lo:
+        return lo
+    if n <= 256:
+        return 1 << (n - 1).bit_length()
+    k = (n - 1).bit_length() - 1  # 2^k < n <= 2^(k+1)
+    base = 1 << k
+    for frac in (1.25, 1.5, 1.75, 2.0):
+        b = int(base * frac)
+        if n <= b:
+            return b
+    raise AssertionError("unreachable")
+
+
+def padded_shape(num_ecs: int, num_machines: int) -> tuple:
+    """The (E_pad, M_pad) the solver will actually run at.
+
+    Shared with the planner's incremental-epsilon heuristic, which must
+    reproduce the solver's scale derivation exactly.
+    """
+    e_pad = max(8, 1 << max(num_ecs - 1, 0).bit_length())
+    return e_pad, bucket_size(num_machines)
+
+
 def choose_scale(num_ecs: int, num_machines: int,
                  max_cost: int = COST_CAP) -> int:
     """Largest cost scale that is safe for int32 push-relabel arithmetic.
@@ -463,14 +496,17 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
 NUM_PHASES = 8
 
 
-def _host_validate(costs, supply, capacity, unsched_cost, scale, eps_start):
+def _host_validate(costs, supply, capacity, unsched_cost, scale, eps_start,
+                   max_cost_hint=None):
     """Input validation + scale/epsilon-schedule derivation (host side).
 
     Shared by the single-chip and mesh-sharded entry points.  Returns
     ``(scale, eps_sched)``.  The scale is derived from the cost bound
     rounded UP to a power of two: jit treats the scale as a static
     argument, so per-round drift in the raw cost range must not mint
-    fresh compile keys.
+    fresh compile keys.  ``max_cost_hint`` (the cost model's static
+    bound) pins the derivation outright — with it, the scale depends
+    only on the padded shape.
     """
     finite = costs[costs < INF_COST]
     if finite.size and finite.max() > COST_CAP:
@@ -482,7 +518,8 @@ def _host_validate(costs, supply, capacity, unsched_cost, scale, eps_start):
 
     E, M = costs.shape
     max_raw = int(max(finite.max() if finite.size else 0,
-                      unsched_cost.max(initial=0), 1))
+                      unsched_cost.max(initial=0),
+                      max_cost_hint or 0, 1))
     max_raw_q = 1 << (max_raw - 1).bit_length() if max_raw > 1 else 1
     max_raw_q = min(max_raw_q, COST_CAP)
     if scale is None:
@@ -658,6 +695,7 @@ def solve_transport(
     max_iter_per_phase: int = 8192,
     max_iter_total: Optional[int] = None,
     scale: Optional[int] = None,
+    max_cost_hint: Optional[int] = None,
 ) -> TransportSolution:
     """Solve the EC->machine transportation problem on device.
 
@@ -692,51 +730,55 @@ def solve_transport(
             gap_bound=0.0,
             iterations=0,
         )
-    # Pad EC rows to a power of two (min 8): row counts churn round to
-    # round, and every distinct shape is a fresh XLA compile.  Padded rows
-    # have zero supply and no admissible arcs, so they are inert.
-    E_pad = max(8, 1 << (E - 1).bit_length())
-    if E_pad != E:
-        costs_p = np.full((E_pad, M), INF_COST, dtype=np.int32)
-        costs_p[:E] = costs
-        supply_p = np.zeros(E_pad, dtype=np.int32)
-        supply_p[:E] = supply
-        unsched_p = np.ones(E_pad, dtype=np.int32)
-        unsched_p[:E] = unsched_cost
-    else:
-        costs_p, supply_p, unsched_p = costs, supply, unsched_cost
+    # Pad EC rows to a power of two (min 8) and machine columns to a
+    # quarter-octave bucket (bucket_size): BOTH axes churn round to round,
+    # and every distinct shape is a fresh XLA compile.  Padded rows have
+    # zero supply; padded columns have zero capacity and no admissible
+    # arcs — both inert.
+    E_pad, M_pad = padded_shape(E, M)
+    costs_p = np.full((E_pad, M_pad), INF_COST, dtype=np.int32)
+    costs_p[:E, :M] = costs
+    supply_p = np.zeros(E_pad, dtype=np.int32)
+    supply_p[:E] = supply
+    unsched_p = np.ones(E_pad, dtype=np.int32)
+    unsched_p[:E] = unsched_cost
+    capacity_p = np.zeros(M_pad, dtype=np.int32)
+    capacity_p[:M] = capacity
 
     scale, eps_sched = _host_validate(
-        costs_p, supply_p, capacity, unsched_p, scale, eps_start
+        costs_p, supply_p, capacity_p, unsched_p, scale, eps_start,
+        max_cost_hint,
     )
-    prices_p = np.zeros(E_pad + M + 1, dtype=np.int32)
+    prices_p = np.zeros(E_pad + M_pad + 1, dtype=np.int32)
     if init_prices is not None:
         # Normalized warm prices are <= 0 with max 0, so the zero-filled
-        # padded rows sit exactly at the anchor and stay inert.
+        # padded rows/columns sit exactly at the anchor and stay inert.
         init_prices = normalize_prices(init_prices)
         prices_p[:E] = init_prices[:E]
-        prices_p[E_pad:] = init_prices[E:]
+        prices_p[E_pad:E_pad + M] = init_prices[E:E + M]
+        prices_p[E_pad + M_pad] = init_prices[E + M]
 
-    J = max(2, min(bid_ranks, M + 1))
+    J = max(2, min(bid_ranks, M_pad + 1))
 
-    flows_p = np.zeros((E_pad, M), dtype=np.int32)
+    flows_p = np.zeros((E_pad, M_pad), dtype=np.int32)
     if init_flows is not None:
-        flows_p[:E] = init_flows
+        flows_p[:E, :M] = init_flows
     fb_p = np.zeros(E_pad, dtype=np.int32)
     if init_unsched is not None:
         fb_p[:E] = init_unsched
-    arc_p = np.full((E_pad, M), _POS, dtype=np.int32)
+    arc_p = np.zeros((E_pad, M_pad), dtype=np.int32)
     if arc_capacity is not None:
         arc_capacity = np.asarray(arc_capacity, dtype=np.int32)
         if (arc_capacity < 0).any():
             raise ValueError("arc_capacity must be non-negative")
-        arc_p[:E] = arc_capacity
-    arc_p[E:] = 0
+        arc_p[:E, :M] = arc_capacity
+    else:
+        arc_p[:E, :M] = _POS
 
     if max_iter_total is None:
         max_iter_total = NUM_PHASES * max_iter_per_phase
     flows, unsched, prices, iters, clean = _solve_device(
-        jnp.asarray(costs_p), jnp.asarray(supply_p), jnp.asarray(capacity),
+        jnp.asarray(costs_p), jnp.asarray(supply_p), jnp.asarray(capacity_p),
         jnp.asarray(unsched_p), jnp.asarray(arc_p),
         jnp.asarray(prices_p),
         jnp.asarray(flows_p),
@@ -745,10 +787,13 @@ def solve_transport(
         jnp.int32(max_iter_total),
         J=J, max_iter=max_iter_per_phase, scale=int(scale),
     )
-    flows = np.asarray(flows)[:E]
+    flows = np.asarray(flows)[:E, :M]
     unsched = np.asarray(unsched)[:E]
     prices_full = np.asarray(prices)
-    prices_out = np.concatenate([prices_full[:E], prices_full[E_pad:]])
+    prices_out = np.concatenate([
+        prices_full[:E], prices_full[E_pad:E_pad + M],
+        prices_full[E_pad + M_pad:],
+    ])
     return _host_finalize(
         flows, unsched, prices_out, iters,
         costs=costs, supply=supply, capacity=capacity,

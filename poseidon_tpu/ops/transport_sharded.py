@@ -78,6 +78,7 @@ def solve_transport_sharded(
     max_iter_per_phase: int = 8192,
     max_iter_total: Optional[int] = None,
     scale: Optional[int] = None,
+    max_cost_hint: Optional[int] = None,
 ) -> TransportSolution:
     """Drop-in mesh-sharded variant of ``transport.solve_transport``.
 
@@ -101,13 +102,15 @@ def solve_transport_sharded(
             init_unsched=init_unsched, eps_start=eps_start,
             bid_ranks=bid_ranks, max_iter_per_phase=max_iter_per_phase,
             max_iter_total=max_iter_total, scale=scale,
+            max_cost_hint=max_cost_hint,
         )
 
-    # Pad machines to a mesh multiple and EC rows to a power of two (the
-    # same shape-stability rationale as the single-chip wrapper): dead
+    # Pad machines to a quarter-octave bucket rounded up to a mesh
+    # multiple, and EC rows to a power of two (the same shape-stability
+    # rationale as the single-chip wrapper — padded_shape): dead
     # columns/rows have zero capacity/supply and no admissible arcs.
-    m_pad = ((M + n_dev - 1) // n_dev) * n_dev
-    e_pad = max(8, 1 << (E - 1).bit_length())
+    e_pad, m_bucket = transport.padded_shape(E, M)
+    m_pad = ((m_bucket + n_dev - 1) // n_dev) * n_dev
     costs_p = np.full((e_pad, m_pad), INF_COST, dtype=np.int32)
     costs_p[:E, :M] = costs
     supply_p = np.zeros(e_pad, dtype=np.int32)
@@ -139,7 +142,8 @@ def solve_transport_sharded(
         prices_p[e_pad + m_pad] = init_prices[E + M]
 
     scale, eps_sched = _host_validate(
-        costs_p, supply_p, capacity_p, unsched_p, scale, eps_start
+        costs_p, supply_p, capacity_p, unsched_p, scale, eps_start,
+        max_cost_hint,
     )
 
     col = NamedSharding(mesh, P(None, MACHINE_AXIS))   # [E, M] matrices

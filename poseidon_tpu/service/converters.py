@@ -62,6 +62,7 @@ def _collect_subtree(
 
 def machine_info_from_proto(
     rtnd: fpb.ResourceTopologyNodeDescriptor,
+    default_slots: int = 0,
 ) -> MachineInfo:
     """Machine record from a topology tree.
 
@@ -105,6 +106,11 @@ def machine_info_from_proto(
         )
     if slots > 0:
         machine.task_slots = slots
+    elif default_slots > 0:
+        # The service's max_tasks_per_pu flag (the Firmament
+        # --max_tasks_per_pu analog) for topologies that carry no
+        # task_capacity of their own.
+        machine.task_slots = default_slots
     return machine
 
 

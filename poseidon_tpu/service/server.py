@@ -49,17 +49,32 @@ class FirmamentServicer:
         self.config = config or FirmamentTPUConfig()
         self.state = state or ClusterState()
         self.planner = planner or RoundPlanner(
-            self.state, get_cost_model(self.config.cost_model)
+            self.state, get_cost_model(self.config.cost_model),
+            gang_scheduling=self.config.gang_scheduling,
+            pod_affinity=self.config.pod_affinity,
+            solver_devices=self.config.solver_devices,
+            flow_solver=self.config.flow_solver,
         )
         # Schedule() rounds are serialized: the planner's warm-start state
         # is single-writer (the reference client also calls Schedule from
         # one loop, cmd/poseidon/poseidon.go:32-72).
         self._schedule_lock = threading.Lock()
+        self._precompiled = False
 
     # ------------------------------------------------------------- scheduling
 
     def Schedule(self, request, context):
         with self._schedule_lock:
+            if self.config.precompile and not self._precompiled:
+                # Compile the (E_bucket, M_bucket) solver ladder up to the
+                # configured ceilings before the first round, so churn
+                # rounds never pay first-compile latency.
+                self._precompiled = True
+                n = self.planner.precompile(
+                    max_ecs=self.config.max_ecs,
+                    max_machines=self.config.max_machines,
+                )
+                log.info("precompiled %d solver shapes", n)
             if self.config.profile_dir:
                 import jax
 
@@ -110,7 +125,9 @@ class FirmamentServicer:
     # ----------------------------------------------------------- node lifecycle
 
     def NodeAdded(self, request, context):
-        machine = converters.machine_info_from_proto(request)
+        machine = converters.machine_info_from_proto(
+            request, default_slots=self.config.max_tasks_per_pu
+        )
         reply = self.state.node_added(machine)
         return fpb.NodeAddedResponse(type=int(reply))
 
@@ -123,7 +140,9 @@ class FirmamentServicer:
         return fpb.NodeRemovedResponse(type=int(reply))
 
     def NodeUpdated(self, request, context):
-        machine = converters.machine_info_from_proto(request)
+        machine = converters.machine_info_from_proto(
+            request, default_slots=self.config.max_tasks_per_pu
+        )
         reply = self.state.node_updated(machine)
         return fpb.NodeUpdatedResponse(type=int(reply))
 

@@ -61,6 +61,64 @@ def transport_objective(
     return int(cost)
 
 
+def transport_solve(
+    costs: np.ndarray,
+    supply: np.ndarray,
+    capacity: np.ndarray,
+    unsched_cost: np.ndarray,
+    arc_capacity: np.ndarray | None = None,
+):
+    """Exact solve returning ``(objective, flows, unsched)``.
+
+    The successive-shortest-path ("ssp") verification solver the service
+    exposes via ``flow_solver=ssp`` (SURVEY.md section 7: "SSP first
+    (correct), Pallas push-relabel second (fast)") — network simplex on
+    host, bit-exact optimal, no device involvement.  Same graph as
+    ``transport_objective``.
+    """
+    costs = np.asarray(costs)
+    supply = np.asarray(supply)
+    capacity = np.asarray(capacity)
+    unsched_cost = np.asarray(unsched_cost)
+    E, M = costs.shape
+    total = int(supply.sum())
+
+    g = nx.DiGraph()
+    g.add_node("src", demand=-total)
+    g.add_node("sink", demand=total)
+    for e in range(E):
+        s = int(supply[e])
+        if s == 0:
+            continue
+        g.add_edge("src", ("ec", e), capacity=s, weight=0)
+        g.add_edge(("ec", e), "sink", capacity=s, weight=int(unsched_cost[e]))
+        for m in range(M):
+            c = int(costs[e, m])
+            if c >= INF_COST or capacity[m] <= 0:
+                continue
+            acap = s if arc_capacity is None else min(s, int(arc_capacity[e, m]))
+            if acap <= 0:
+                continue
+            g.add_edge(("ec", e), ("mach", m), capacity=acap, weight=c)
+    for m in range(M):
+        if capacity[m] > 0:
+            g.add_edge(("mach", m), "sink", capacity=int(capacity[m]), weight=0)
+
+    cost, flow = nx.network_simplex(g)
+    flows = np.zeros((E, M), dtype=np.int32)
+    unsched = np.zeros(E, dtype=np.int32)
+    for e in range(E):
+        out = flow.get(("ec", e))
+        if not out:
+            continue
+        for dst, amount in out.items():
+            if dst == "sink":
+                unsched[e] = amount
+            else:
+                flows[e, dst[1]] = amount
+    return int(cost), flows, unsched
+
+
 def mcmf_objective(
     n: int,
     arcs: list,

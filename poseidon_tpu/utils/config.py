@@ -41,16 +41,25 @@ class FirmamentTPUConfig:
     # Cost model selection; "cpu_mem" reproduces the reference's active model
     # (README.md:57-59).  Others: "trivial", "net", "coco", "whare".
     cost_model: str = "cpu_mem"
-    # Solver selection (upstream analog: cs2 vs flowlessly).
-    flow_solver: str = "auction"  # or "ssp"
-    # Static-shape bucketing for recompile avoidance.
+    # Solver selection (upstream analog: cs2 vs flowlessly): "auction" is
+    # the TPU cost-scaling push-relabel kernel; "ssp" the host
+    # successive-shortest-path verification solver (exact, slow).
+    flow_solver: str = "auction"
+    # Precompile ceilings: with precompile=True the first Schedule()
+    # compiles the solver's (E_bucket, M_bucket) shape ladder up to these
+    # bounds so churn rounds never pay first-compile latency.
+    precompile: bool = False
     max_machines: int = 1024
     max_ecs: int = 256
+    # Default per-machine task slots when the node topology carries no
+    # task_capacity (the Firmament --max_tasks_per_pu analog).
     max_tasks_per_pu: int = 100
-    # Gang scheduling / affinity toggles.
-    gang_scheduling: bool = False
-    pod_affinity: bool = False
-    # Number of devices to shard the solve over (1 = single chip).
+    # Feature gates: tasks opt in via labels; these disable the machinery
+    # wholesale (gang repair re-solves / affinity cost terms).
+    gang_scheduling: bool = True
+    pod_affinity: bool = True
+    # Number of devices to shard the solve's machine axis over (1 =
+    # single chip; >1 = NamedSharding over an ICI mesh).
     solver_devices: int = 1
     # When set, each Schedule() round is captured with the JAX profiler
     # into this directory (xprof trace; SURVEY.md section 5).

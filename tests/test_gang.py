@@ -23,6 +23,26 @@ def gang_task(uid, job, cpu=1000, ram=1 << 18):
     )
 
 
+def test_gang_gate_off_allows_partial_placement():
+    """gang_scheduling=False (FirmamentTPUConfig gate) disables the
+    atomicity repair: a too-big gang places partially like ordinary
+    tasks instead of being fully evicted."""
+    st = ClusterState()
+    for i in range(3):
+        st.node_added(
+            MachineInfo(
+                uuid=f"m-{i}", cpu_capacity=1000, ram_capacity=1 << 24
+            )
+        )
+    for i in range(5):
+        st.task_submitted(gang_task(task_uid("gj", i), "gang-job"))
+    planner = RoundPlanner(
+        st, get_cost_model("cpu_mem"), gang_scheduling=False
+    )
+    _, m = planner.schedule_round()
+    assert m.placed == 3 and m.unscheduled == 2
+
+
 def test_gang_places_fully_when_it_fits():
     st = ClusterState()
     for i in range(4):

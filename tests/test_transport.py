@@ -281,3 +281,46 @@ def test_returned_prices_are_anchored():
     costs, supply, cap, unsched = random_instance(rng, 5, 7)
     sol = solve_transport(costs, supply, cap, unsched)
     assert sol.prices.max() == 0
+
+
+def test_bucket_size_ladder():
+    from poseidon_tpu.ops.transport import bucket_size
+
+    assert bucket_size(1) == 32
+    assert bucket_size(32) == 32
+    assert bucket_size(33) == 64
+    assert bucket_size(256) == 256
+    assert bucket_size(300) == 320        # 1.25 * 256
+    assert bucket_size(4000) == 4096
+    assert bucket_size(10_000) == 10_240  # 1.25 * 8192: 2.4% waste
+    # Monotone and always >= n.
+    prev = 0
+    for n in range(1, 3000, 7):
+        b = bucket_size(n)
+        assert b >= n and b >= prev
+        prev = b
+
+
+def test_shape_churn_does_not_recompile():
+    """EC/machine counts moving within a bucket, and cost maxima drifting
+    under a max_cost_hint, must all reuse one compile key — per-round
+    recompiles were the round-2 churn storm (27x wave latency)."""
+    from poseidon_tpu.ops.transport import _solve_device
+
+    rng = np.random.default_rng(5)
+
+    def solve(E, M, max_cost):
+        costs, supply, cap, unsched = random_instance(
+            rng, E, M, max_cost=max_cost
+        )
+        return solve_transport(
+            costs, supply, cap, unsched, max_cost_hint=500
+        )
+
+    solve(9, 33, 500)  # warm the cache at the (16, 64) bucket
+    before = _solve_device._cache_size()
+    solve(10, 40, 500)   # same buckets, different extents
+    solve(12, 64, 500)   # M at the bucket edge
+    solve(16, 50, 137)   # cost bound drifts under the hint
+    solve(13, 48, 20)
+    assert _solve_device._cache_size() == before
