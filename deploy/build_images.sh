@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Build the three poseidon-tpu images (the analog of the reference's
+# deploy/build_docker_image.sh).  Run from the repo root.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TAG="${TAG:-latest}"
+for target in firmament-tpu poseidon metrics-agent; do
+  docker build -f deploy/Dockerfile --target "$target" \
+    -t "poseidon-tpu/${target}:${TAG}" .
+done
+echo "built: poseidon-tpu/{firmament-tpu,poseidon,metrics-agent}:${TAG}"

@@ -92,6 +92,14 @@ class MachineTable:
     resident_kv: Optional[List[Dict[Tuple[str, str], int]]] = None
     resident_key: Optional[List[Dict[str, int]]] = None
     resident_total: Optional[np.ndarray] = None    # int64 [M]
+    # Observed committed load: like cpu_used/ram_used but with each
+    # resident's reservation replaced by its knowledge-base usage EMA
+    # (AddTaskStats history) when one exists.  None when the task KB is
+    # empty (or in global-reschedule mode, where reservations are zero).
+    # Cost models use it for load pricing only — fit stays
+    # reservation-based.
+    cpu_obs_used: Optional[np.ndarray] = None      # int64 [M] millicores
+    ram_obs_used: Optional[np.ndarray] = None      # int64 [M] KB
 
     @property
     def num_machines(self) -> int:

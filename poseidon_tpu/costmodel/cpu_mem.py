@@ -126,14 +126,27 @@ class CpuMemCostModel(base.CostModel):
                     arc_cap[e] = np.minimum(arc_cap[e], 1)
 
         # Load after placement, per dimension, blending reserved and
-        # measured load.
+        # measured load.  The committed term prefers the knowledge base's
+        # observed per-task usage (AddTaskStats EMAs, rolled up per
+        # machine in build_round_view) over raw reservations when
+        # history exists — chronically hungry residents price their
+        # machine up, chronically idle ones price it down.  Fit above
+        # stays reservation-based.
+        cpu_committed = (
+            machines.cpu_obs_used
+            if machines.cpu_obs_used is not None else machines.cpu_used
+        )
+        ram_committed = (
+            machines.ram_obs_used
+            if machines.ram_obs_used is not None else machines.ram_used
+        )
         w = float(self.measured_weight)
         cpu_load = (
-            (1.0 - w) * (machines.cpu_used[None, :] + cpu_req) / cpu_cap[None, :]
+            (1.0 - w) * (cpu_committed[None, :] + cpu_req) / cpu_cap[None, :]
             + w * machines.cpu_util.astype(np.float64)[None, :]
         )
         mem_load = (
-            (1.0 - w) * (machines.ram_used[None, :] + ram_req) / ram_cap[None, :]
+            (1.0 - w) * (ram_committed[None, :] + ram_req) / ram_cap[None, :]
             + w * machines.mem_util.astype(np.float64)[None, :]
         )
         wc = float(self.cpu_weight)

@@ -30,96 +30,8 @@ except ImportError as _exc:  # pragma: no cover - gated dependency
     ) from _exc
 
 from poseidon_tpu.glue.fake_kube import Event, KubeAPI, Node, Pod
-
-_CPU_MULT = {"m": 1, "": 1000}
-
-
-def _parse_cpu(q: str) -> int:
-    """K8s CPU quantity -> millicores (podwatcher.go:135-147 semantics)."""
-    if not q:
-        return 0
-    if q.endswith("m"):
-        return int(q[:-1])
-    return int(float(q) * 1000)
-
-
-_MEM_SUFFIX = {
-    "Ki": 1, "Mi": 1 << 10, "Gi": 1 << 20, "Ti": 1 << 30,
-    "K": 1, "M": 10 ** 3, "G": 10 ** 6, "T": 10 ** 9,
-}
-
-
-def _parse_mem_kb(q: str) -> int:
-    """K8s memory quantity -> KB (the node watcher's unit)."""
-    if not q:
-        return 0
-    for suf, mult in _MEM_SUFFIX.items():
-        if q.endswith(suf):
-            return int(float(q[: -len(suf)]) * mult)
-    return int(q) >> 10  # plain bytes
-
-
-def _pod_from_v1(p) -> Pod:
-    cpu = ram = 0
-    for c in p.spec.containers or []:
-        req = (c.resources and c.resources.requests) or {}
-        cpu += _parse_cpu(req.get("cpu", ""))
-        ram += _parse_mem_kb(req.get("memory", ""))
-    owner = ""
-    if p.metadata.owner_references:
-        owner = p.metadata.owner_references[0].uid
-    affinity = {}
-    anti = {}
-    aff = p.spec.affinity
-    if aff and aff.pod_affinity:
-        for term in (
-            aff.pod_affinity
-            .required_during_scheduling_ignored_during_execution or []
-        ):
-            if term.label_selector and term.label_selector.match_labels:
-                affinity.update(term.label_selector.match_labels)
-    if aff and aff.pod_anti_affinity:
-        for term in (
-            aff.pod_anti_affinity
-            .required_during_scheduling_ignored_during_execution or []
-        ):
-            if term.label_selector and term.label_selector.match_labels:
-                anti.update(term.label_selector.match_labels)
-    return Pod(
-        name=p.metadata.name,
-        namespace=p.metadata.namespace,
-        owner_uid=owner,
-        scheduler_name=p.spec.scheduler_name or "",
-        phase=p.status.phase or "Unknown",
-        node_name=p.spec.node_name or "",
-        cpu_request=cpu,
-        ram_request=ram,
-        labels=dict(p.metadata.labels or {}),
-        node_selector=dict(p.spec.node_selector or {}),
-        pod_affinity=affinity,
-        pod_anti_affinity=anti,
-        deleted=p.metadata.deletion_timestamp is not None,
-    )
-
-
-def _node_from_v1(n) -> Node:
-    cap = n.status.capacity or {}
-    ready = True
-    out_of_disk = False
-    for cond in n.status.conditions or []:
-        if cond.type == "Ready":
-            ready = cond.status == "True"
-        if cond.type == "OutOfDisk":
-            out_of_disk = cond.status == "True"
-    return Node(
-        name=n.metadata.name,
-        cpu_capacity=_parse_cpu(cap.get("cpu", "")),
-        ram_capacity=_parse_mem_kb(cap.get("memory", "")),
-        unschedulable=bool(n.spec.unschedulable),
-        ready=ready,
-        out_of_disk=out_of_disk,
-        labels=dict(n.metadata.labels or {}),
-    )
+from poseidon_tpu.glue.kube_convert import node_from_v1 as _node_from_v1
+from poseidon_tpu.glue.kube_convert import pod_from_v1 as _pod_from_v1
 
 
 class RealKube(KubeAPI):

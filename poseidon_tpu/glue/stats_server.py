@@ -52,10 +52,12 @@ def node_stats_to_resource_stats(
 
 def pod_stats_to_task_stats(ps: spb.PodStats, task_id: int) -> fpb.TaskStats:
     """PodStats -> TaskStats, field-for-field (stats.go:56-75)."""
+    # PodStats carries no timestamp (poseidonstats.proto:38-66); the
+    # TaskStats one is left at its default, as in the reference's
+    # conversion (stats.go:56-75).
     return fpb.TaskStats(
         task_id=task_id,
         hostname=ps.hostname,
-        timestamp=ps.timestamp,
         cpu_limit=ps.cpu_limit,
         cpu_request=ps.cpu_request,
         cpu_usage=ps.cpu_usage,

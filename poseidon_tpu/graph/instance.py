@@ -175,12 +175,22 @@ def _slice_ecs(ecs, idx: np.ndarray):
 
 
 def _with_usage(mt, cpu_used, ram_used, net_used, slots_free):
-    """MachineTable with this band's committed-resource view."""
+    """MachineTable with this band's committed-resource view.
+
+    The observed-load arrays (knowledge-base usage EMAs) must advance by
+    the same intra-round commitment delta as the reservations, or later
+    bands would price machines at their pre-round load whenever usage
+    history exists."""
     from dataclasses import replace
 
+    kw = {}
+    if mt.cpu_obs_used is not None:
+        kw["cpu_obs_used"] = mt.cpu_obs_used + (cpu_used - mt.cpu_used)
+    if mt.ram_obs_used is not None:
+        kw["ram_obs_used"] = mt.ram_obs_used + (ram_used - mt.ram_used)
     return replace(
         mt, cpu_used=cpu_used, ram_used=ram_used,
-        net_rx_used=net_used, slots_free=slots_free,
+        net_rx_used=net_used, slots_free=slots_free, **kw,
     )
 
 

@@ -161,6 +161,13 @@ class MachineInfo:
 @dataclass
 class _KBEntry:
     samples: deque = field(default_factory=lambda: deque(maxlen=_STATS_WINDOW))
+    # EMA of observed usage (AddTaskStats cpu_usage millicores / mem_usage
+    # KB); -1 = no data yet.  This is what closes the knowledge-base loop:
+    # build_round_view folds it into the machines' observed load and the
+    # interference census (reference intent: task usage history informs
+    # the cost models, pkg/stats/stats.go:77-159).
+    cpu_usage: float = -1.0
+    mem_usage: float = -1.0
 
 
 @dataclass
@@ -457,7 +464,18 @@ class ClusterState:
         with self._lock:
             if uid not in self.tasks:
                 return TaskReply.NOT_FOUND
-            self.task_kb.setdefault(uid, _KBEntry()).samples.append(sample)
+            entry = self.task_kb.setdefault(uid, _KBEntry())
+            entry.samples.append(sample)
+            alpha = 0.5
+            for key in ("cpu_usage", "mem_usage"):
+                v = sample.get(key)
+                if v is None:
+                    continue
+                prev = getattr(entry, key)
+                new = float(v) if prev < 0 else (
+                    alpha * float(v) + (1 - alpha) * prev
+                )
+                setattr(entry, key, new)
             return TaskReply.SUBMITTED_OK
 
     def add_node_stats(self, resource_uuid: str, sample: dict) -> NodeReply:
@@ -520,6 +538,62 @@ class ClusterState:
                 # No-op batches leave the generation untouched so quiet
                 # rounds stay recognizable to the incremental fast path.
                 self.generation += 1
+
+    @staticmethod
+    def _observed_class(task, entry) -> int:
+        """Interference class refined by observed usage: a task whose
+        measured CPU dwarfs its request behaves as a DEVIL whatever its
+        label says; one far under it is a SHEEP (Whare-Map's 'observed
+        interference' intent, whare_map_stats.proto:23-29)."""
+        if entry.cpu_usage < 0 or task.cpu_request <= 0:
+            return task.task_type & 3
+        if entry.cpu_usage > 2.0 * task.cpu_request:
+            return 2  # DEVIL
+        if entry.cpu_usage < 0.25 * task.cpu_request:
+            return 0  # SHEEP
+        return task.task_type & 3
+
+    def _kb_observed(self, uuid_to_col, census, cpu_used, ram_used,
+                     include_running: bool):
+        """Fold the task-usage knowledge base into the round view.
+
+        O(|task_kb|): for every resident task with usage history, (a)
+        shift the machine's observed load by (usage EMA - reservation)
+        and (b) move its census entry to its observed interference class.
+        Returns ``(cpu_obs, ram_obs)`` (int64 [M]) or ``(None, None)``
+        when there is nothing to observe.  Caller holds the lock.
+        """
+        import numpy as np
+
+        if include_running or not self.task_kb:
+            return None, None
+        cpu_obs = cpu_used.astype(np.float64)
+        ram_obs = ram_used.astype(np.float64)
+        touched = False
+        for uid, entry in self.task_kb.items():
+            t = self.tasks.get(uid)
+            if t is None or t.state != TaskState.RUNNING:
+                continue
+            col = uuid_to_col.get(t.scheduled_to, -1) \
+                if t.scheduled_to else -1
+            if col < 0:
+                continue
+            touched = True
+            if entry.cpu_usage >= 0:
+                cpu_obs[col] += entry.cpu_usage - t.cpu_request
+            if entry.mem_usage >= 0:
+                ram_obs[col] += entry.mem_usage - t.ram_request
+            obs_cls = self._observed_class(t, entry)
+            labeled = t.task_type & 3
+            if obs_cls != labeled:
+                census[col, labeled] -= 1
+                census[col, obs_cls] += 1
+        if not touched:
+            return None, None
+        return (
+            np.maximum(np.rint(cpu_obs), 0).astype(np.int64),
+            np.maximum(np.rint(ram_obs), 0).astype(np.int64),
+        )
 
     def build_round_view(self, include_running: bool = False):
         """Columnar tables for one round, built in a single pass under the
@@ -614,6 +688,10 @@ class ClusterState:
                     census[j, 2] += dev
                     census[j, 3] += tur
 
+            cpu_obs, ram_obs = self._kb_observed(
+                uuid_to_col, census, cpu_used, ram_used, include_running
+            )
+
             ec_ids = sorted(groups)
             member_uids, member_cur, member_wait = [], [], []
             supply = np.empty(len(ec_ids), dtype=np.int32)
@@ -705,6 +783,8 @@ class ClusterState:
                 resident_kv=res_kv,
                 resident_key=res_key,
                 resident_total=res_total,
+                cpu_obs_used=cpu_obs,
+                ram_obs_used=ram_obs,
             )
             return RoundView(
                 ecs=ecs,
@@ -787,6 +867,11 @@ class ClusterState:
                     census[j, 2] += dev
                     census[j, 3] += tur
 
+            cpu_obs, ram_obs = self._kb_observed(
+                {m.uuid: j for j, m in enumerate(machines)},
+                census, cpu_used, ram_used, include_running,
+            )
+
             ecs = ECTable(
                 ec_ids=ec_ids,
                 cpu_request=np.array(
@@ -846,6 +931,8 @@ class ClusterState:
                 resident_kv=res_kv,
                 resident_key=res_key,
                 resident_total=res_total,
+                cpu_obs_used=cpu_obs,
+                ram_obs_used=ram_obs,
             )
             return RoundView(
                 ecs=ecs,

@@ -300,6 +300,39 @@ def test_finished_pod_stats_not_found(system):
     assert poseidon.schedule_once() == []
 
 
+def test_metrics_agent_pushes_into_knowledge_base(system):
+    """The metrics agent (the Heapster-sink analog, glue/metrics_agent.py)
+    polls a source and streams usage into the live stats server; the
+    firmament state's knowledge base must reflect it."""
+    from poseidon_tpu.glue.metrics_agent import MetricsAgent
+
+    kube, poseidon, server = system
+    kube.add_node(Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.create_pod(Pod(name="p1", cpu_request=100, ram_request=1 << 18))
+    assert poseidon.drain_watchers()
+    poseidon.schedule_once()
+
+    def source():
+        return (
+            [spb.NodeStats(hostname="n1", cpu_utilization=0.7,
+                           mem_utilization=0.6)],
+            [spb.PodStats(name="p1", namespace="default",
+                          cpu_usage=90, mem_usage=1 << 17)],
+        )
+
+    agent = MetricsAgent(source, poseidon.stats_server.address)
+    try:
+        n_ok, p_ok = agent.push_once()
+    finally:
+        agent.stop()
+    assert (n_ok, p_ok) == (1, 1)
+    st = server.servicer.state
+    machine = next(iter(st.machines.values()))
+    assert machine.cpu_util > 0  # EMA moved by the agent's sample
+    assert any(e.samples for e in st.node_kb.values())
+    assert any(e.samples for e in st.task_kb.values())
+
+
 def test_stats_stream_roundtrip(system):
     """Heapster-style stream -> stats server -> firmament knowledge base
     (stats.go:77-159), then the cost model steers away from the hot node."""

@@ -1,0 +1,131 @@
+"""Knowledge-base loop: AddTaskStats history must change placements.
+
+The reference feeds task and node usage history into the scheduler's
+cost models via the stats path (reference pkg/stats/stats.go:77-159);
+round-2 review flagged that TaskStats were stored but never read.  These
+tests pin the loop end to end: stats in -> observed machine load /
+observed interference class -> different placement out.
+"""
+
+import numpy as np
+
+from poseidon_tpu.costmodel import get_cost_model
+from poseidon_tpu.graph.instance import RoundPlanner
+from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+
+
+def mk_machine(uuid, cpu=10_000, ram=1 << 24):
+    return MachineInfo(uuid=uuid, cpu_capacity=cpu, ram_capacity=ram)
+
+
+def _place_one_each(st, planner):
+    """Two resident tasks, one per machine (placed over two rounds: the
+    load term prices machines by committed state, so round two spreads);
+    returns {machine_uuid: uid}."""
+    st.task_submitted(TaskInfo(uid=1, job_id="res-a", cpu_request=100,
+                               ram_request=1 << 10))
+    _, m = planner.schedule_round()
+    assert m.placed == 1
+    st.task_submitted(TaskInfo(uid=2, job_id="res-b", cpu_request=101,
+                               ram_request=1 << 10))
+    _, m = planner.schedule_round()
+    assert m.placed == 1
+    out = {st.tasks[uid].scheduled_to: uid for uid in (1, 2)}
+    assert len(out) == 2, "residents did not spread"
+    return out
+
+
+def test_task_stats_shift_placement_cpu_mem():
+    """Identical reservations on both machines, but the KB says machine
+    A's resident is a CPU hog: the next task must land on machine B."""
+    st = ClusterState()
+    st.node_added(mk_machine("m-a"))
+    st.node_added(mk_machine("m-b"))
+    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+    by_machine = _place_one_each(st, planner)
+
+    hog_machine = "m-a"
+    hog_uid = by_machine[hog_machine]
+    other_machine = next(u for u in by_machine if u != hog_machine)
+    # Observed usage 50x the reservation.
+    assert st.add_task_stats(hog_uid, {"cpu_usage": 5000, "mem_usage": 1 << 10})
+
+    st.task_submitted(TaskInfo(uid=3, job_id="new", cpu_request=100,
+                               ram_request=1 << 10))
+    deltas, m = planner.schedule_round()
+    assert m.placed == 1
+    assert st.tasks[3].scheduled_to == other_machine
+
+
+def test_task_stats_can_attract_placement_too():
+    """Symmetric: the KB showing a resident chronically idle makes its
+    machine CHEAPER than the reservation picture suggests."""
+    st = ClusterState()
+    st.node_added(mk_machine("m-a"))
+    st.node_added(mk_machine("m-b"))
+    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+
+    # Heavy reservation on one machine, light on the other.
+    st.task_submitted(TaskInfo(uid=1, job_id="res-a", cpu_request=4000,
+                               ram_request=1 << 10))
+    _, m = planner.schedule_round()
+    assert m.placed == 1
+    heavy_machine = st.tasks[1].scheduled_to
+    st.task_submitted(TaskInfo(uid=2, job_id="res-b", cpu_request=500,
+                               ram_request=1 << 10))
+    planner.schedule_round()
+    assert st.tasks[2].scheduled_to != heavy_machine
+
+    # Without stats a new task avoids the big reservation...
+    st.task_submitted(TaskInfo(uid=4, job_id="probe", cpu_request=100,
+                               ram_request=1 << 10))
+    planner.schedule_round()
+    assert st.tasks[4].scheduled_to != heavy_machine
+    st.task_removed(4)
+
+    # ...but history shows the big reservation actually uses ~nothing,
+    # while the other machine's picture is unchanged.
+    st.add_task_stats(1, {"cpu_usage": 10, "mem_usage": 1 << 10})
+    st.task_submitted(TaskInfo(uid=3, job_id="new", cpu_request=100,
+                               ram_request=1 << 10))
+    planner.schedule_round()
+    assert st.tasks[3].scheduled_to == heavy_machine
+
+
+def test_observed_class_refines_whare_census():
+    """A resident labeled SHEEP whose usage history screams DEVIL must
+    repel an incoming TURTLE under the Whare-Map model."""
+    st = ClusterState()
+    st.node_added(mk_machine("m-a"))
+    st.node_added(mk_machine("m-b"))
+    planner = RoundPlanner(st, get_cost_model("whare"))
+    by_machine = _place_one_each(st, planner)  # both residents type SHEEP
+
+    wolf_machine = "m-a"
+    wolf_uid = by_machine[wolf_machine]
+    other_machine = next(u for u in by_machine if u != wolf_machine)
+    # Usage 30x request: observed class flips SHEEP -> DEVIL.  Memory is
+    # kept at the reservation so cpu_mem's base load term stays balanced
+    # against the small cpu delta; the census flip dominates.
+    view = st.build_round_view()
+    st.add_task_stats(wolf_uid, {"cpu_usage": 3000, "mem_usage": 1 << 10})
+    view2 = st.build_round_view()
+    col_a = view2.machines.uuids.index(wolf_machine)
+    assert view2.machines.type_census[col_a, 2] == 1  # now a DEVIL
+    assert view.machines.type_census[col_a, 2] == 0
+
+    # TURTLE pays 100/resident next to a DEVIL vs 5 next to a SHEEP.
+    st.task_submitted(TaskInfo(uid=9, job_id="turtle", cpu_request=100,
+                               ram_request=1 << 10, task_type=3))
+    planner.schedule_round()
+    assert st.tasks[9].scheduled_to == other_machine
+
+
+def test_kb_absent_means_no_obs_arrays():
+    st = ClusterState()
+    st.node_added(mk_machine("m-a"))
+    st.task_submitted(TaskInfo(uid=1, job_id="j", cpu_request=10,
+                               ram_request=1 << 10))
+    view = st.build_round_view()
+    assert view.machines.cpu_obs_used is None
+    assert view.machines.ram_obs_used is None

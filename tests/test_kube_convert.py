@@ -1,0 +1,134 @@
+"""Unit tests for the K8s object conversion layer (no cluster, no
+``kubernetes`` package): quantity parsing and V1Pod/V1Node mapping over
+duck-typed stand-ins — the surface the reference covers in
+pkg/k8sclient/nodewatcher_test.go:120-216 and podwatcher_test.go."""
+
+from types import SimpleNamespace as NS
+
+import pytest
+
+from poseidon_tpu.glue.kube_convert import (
+    node_from_v1,
+    parse_cpu,
+    parse_mem_kb,
+    pod_from_v1,
+)
+
+
+@pytest.mark.parametrize("q,want", [
+    ("", 0),
+    ("100m", 100),
+    ("1", 1000),
+    ("2", 2000),
+    ("0.5", 500),
+    ("1.5", 1500),
+    ("250m", 250),
+])
+def test_parse_cpu(q, want):
+    assert parse_cpu(q) == want
+
+
+@pytest.mark.parametrize("q,want", [
+    ("", 0),
+    ("1024", 1),            # plain bytes -> KB
+    ("2048Ki", 2048),
+    ("1Mi", 1 << 10),
+    ("2Gi", 2 << 20),
+    ("1Ti", 1 << 30),
+    ("1000K", 1000),
+    ("1M", 1000),
+    ("2G", 2 * 10 ** 6),
+    ("1.5Gi", int(1.5 * (1 << 20))),
+])
+def test_parse_mem_kb(q, want):
+    assert parse_mem_kb(q) == want
+
+
+def _v1_pod(**kw):
+    containers = [
+        NS(resources=NS(requests={"cpu": "250m", "memory": "512Mi"})),
+        NS(resources=NS(requests={"cpu": "0.5", "memory": "1Gi"})),
+    ]
+    meta = NS(
+        name=kw.get("name", "p1"),
+        namespace="default",
+        owner_references=kw.get("owners"),
+        labels=kw.get("labels"),
+        deletion_timestamp=kw.get("deletion_timestamp"),
+    )
+    spec = NS(
+        containers=containers,
+        scheduler_name="poseidon",
+        node_name=kw.get("node_name", ""),
+        node_selector=kw.get("node_selector"),
+        affinity=kw.get("affinity"),
+    )
+    status = NS(phase=kw.get("phase", "Pending"))
+    return NS(metadata=meta, spec=spec, status=status)
+
+
+def test_pod_requests_summed_across_containers():
+    pod = pod_from_v1(_v1_pod())
+    assert pod.cpu_request == 250 + 500
+    assert pod.ram_request == (512 << 10) + (1 << 20)
+    assert pod.scheduler_name == "poseidon"
+    assert pod.phase == "Pending"
+    assert not pod.deleted
+
+
+def test_pod_owner_and_deletion():
+    pod = pod_from_v1(_v1_pod(
+        owners=[NS(uid="rs-123")], deletion_timestamp="2026-01-01",
+    ))
+    assert pod.owner_uid == "rs-123"
+    assert pod.deleted
+
+
+def test_pod_affinity_terms_extracted():
+    term = NS(label_selector=NS(match_labels={"app": "db"}))
+    anti = NS(label_selector=NS(match_labels={"app": "web"}))
+    affinity = NS(
+        pod_affinity=NS(
+            required_during_scheduling_ignored_during_execution=[term]
+        ),
+        pod_anti_affinity=NS(
+            required_during_scheduling_ignored_during_execution=[anti]
+        ),
+    )
+    pod = pod_from_v1(_v1_pod(affinity=affinity))
+    assert pod.pod_affinity == {"app": "db"}
+    assert pod.pod_anti_affinity == {"app": "web"}
+
+
+def _v1_node(conditions=(), unschedulable=False, cpu="4", mem="16Gi"):
+    return NS(
+        metadata=NS(name="n1", labels={"zone": "a"}),
+        spec=NS(unschedulable=unschedulable),
+        status=NS(
+            capacity={"cpu": cpu, "memory": mem},
+            conditions=list(conditions),
+        ),
+    )
+
+
+def test_node_capacity_and_labels():
+    node = node_from_v1(_v1_node())
+    assert node.cpu_capacity == 4000
+    assert node.ram_capacity == 16 << 20
+    assert node.labels == {"zone": "a"}
+    assert node.ready and not node.out_of_disk and not node.unschedulable
+
+
+@pytest.mark.parametrize("ctype,status,field,want", [
+    ("Ready", "False", "ready", False),
+    ("Ready", "True", "ready", True),
+    ("OutOfDisk", "True", "out_of_disk", True),
+    ("OutOfDisk", "False", "out_of_disk", False),
+])
+def test_node_condition_mapping(ctype, status, field, want):
+    node = node_from_v1(_v1_node(conditions=[NS(type=ctype, status=status)]))
+    assert getattr(node, field) is want
+
+
+def test_node_unschedulable_gate():
+    assert node_from_v1(_v1_node(unschedulable=True)).unschedulable
