@@ -14,9 +14,18 @@ from poseidon_tpu.glue.fake_kube import Node, Pod
 
 
 def parse_cpu(q: str) -> int:
-    """K8s CPU quantity -> millicores (podwatcher.go:135-147 semantics)."""
+    """K8s CPU quantity -> millicores (podwatcher.go:135-147 semantics).
+
+    Also accepts the nanocore/microcore forms metrics.k8s.io serializes
+    usage in (e.g. ``231584746n``) — requests use ``m``/plain cores, but
+    the metrics agent feeds usage through the same parser.
+    """
     if not q:
         return 0
+    if q.endswith("n"):
+        return int(int(q[:-1]) / 1_000_000)
+    if q.endswith("u"):
+        return int(int(q[:-1]) / 1_000)
     if q.endswith("m"):
         return int(q[:-1])
     return int(float(q) * 1000)

@@ -155,7 +155,9 @@ class MetricsAgent:
         while not self._stop.is_set():
             try:
                 self.push_once()
-            except grpc.RpcError as e:
+            except Exception as e:  # noqa: BLE001 - the poll loop must
+                # survive transient API/stream failures (metrics-server
+                # rollouts, channel resets) and retry next interval.
                 log.warning("stats push failed: %s", e)
             self._stop.wait(self.interval)
 

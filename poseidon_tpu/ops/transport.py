@@ -22,8 +22,8 @@ This is safe because
   relabel value ``max_candidate - eps`` then strictly decreases the node's
   potential while keeping every residual arc's reduced cost >= -eps.
 
-Every step is a dense vectorized primitive (masked top_k, cumsum-greedy
-multi-arc pushes, masked max reductions) over ``[E, M]`` int32 arrays —
+Every step is a dense vectorized primitive (cumsum-allocated full-width
+pushes, masked max reductions) over ``[E, M]`` int32 arrays —
 no data-dependent shapes, no host round-trips — wrapped in
 ``lax.while_loop`` inside one jitted kernel.  The sink is a normal node
 with its own potential, so over-delivery (possible after a phase's
@@ -129,27 +129,33 @@ class TransportSolution:
     iterations: int         # total push/relabel iterations across phases
 
 
-def _greedy_push(rc, resid, excess, k):
-    """Multi-arc admissible push for a batch of nodes.
+def _greedy_push(rc, resid, excess):
+    """Full-width admissible push for a batch of nodes.
 
     rc, resid: [N, A] reduced costs / residual capacities of each node's
-    outgoing residual arcs.  excess: [N].  Pushes are allocated greedily to
-    the most negative reduced costs first (top-k per node), each bounded by
-    its residual capacity, totalling at most the node's excess.  Returns the
-    pushed amounts [N, A] (zero where not admissible or excess <= 0).
+    outgoing residual arcs.  excess: [N].  Pushes are allocated across ALL
+    admissible arcs (rc < 0) in arc-index order via a per-row cumsum,
+    each bounded by its residual capacity, totalling at most the node's
+    excess.  Returns the pushed amounts [N, A].
+
+    Any admissible push preserves eps-optimality, so cheapest-first
+    ordering is not required for correctness — and a top-k push bounded
+    the per-iteration drain rate so hard that a phase refine saturating a
+    wide arc layer (e.g. machine->sink at 10k machines) took O(layer/k)
+    iterations to push back (~1250 iterations per phase measured at the
+    10k-machine scale; full-width: ~35).  The cumsum also replaces the
+    top_k + scatter-add pair, cutting per-iteration cost.
     """
     admissible = (rc < 0) & (resid > 0) & (excess[:, None] > 0)
-    key = jnp.where(admissible, -rc, _NEG)
-    kk = min(k, rc.shape[1])
-    vals, idx = lax.top_k(key, kk)                       # [N, kk]
-    res_at = jnp.take_along_axis(resid, idx, axis=1)
-    res_at = jnp.where(vals > 0, res_at, 0)
+    res_at = jnp.where(admissible, resid, 0)
+    # int32 cumsum headroom: the running sum spans the whole row, so a
+    # row's total residual must stay below 2**31.  EC rows are the only
+    # risk (up to M_pad * supply_e); solve_transport splits rows whose
+    # supply exceeds the headroom bound before they reach the kernel
+    # (machine rows sum to <= total supply, the sink row to <= slots +
+    # supply).
     before = jnp.cumsum(res_at, axis=1) - res_at
-    amt = jnp.clip(jnp.minimum(res_at, excess[:, None] - before), 0, None)
-    push = jnp.zeros_like(rc).at[
-        jnp.arange(rc.shape[0])[:, None], idx
-    ].add(amt)
-    return push
+    return jnp.clip(jnp.minimum(res_at, excess[:, None] - before), 0, None)
 
 
 def _relabel(rc, resid, cand, excess, p, eps):
@@ -319,7 +325,7 @@ def _excesses(F, Ffb, Fmt, *, supply, total):
     return exc_e, exc_m, exc_t
 
 
-def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, J, max_iter,
+def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
               max_iter_total):
     """One epsilon phase: refine the carried flows to the new eps, then
     synchronous push/relabel until every excess is zero.
@@ -380,9 +386,9 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, J, max_iter,
         # === push sweep (prices frozen; opposite arcs can't both be
         # admissible, so simultaneous updates never contest an arc) ===
         ec, m, t = arcs(F, Ffb, Fmt, pe, pm, pt)
-        ec_push = _greedy_push(ec["rc"], ec["resid"], exc_e, J)
-        m_push = _greedy_push(m["rc"], m["resid"], exc_m, J)
-        t_push = _greedy_push(t["rc"], t["resid"], exc_t[None], J)[0]
+        ec_push = _greedy_push(ec["rc"], ec["resid"], exc_e)
+        m_push = _greedy_push(m["rc"], m["resid"], exc_m)
+        t_push = _greedy_push(t["rc"], t["resid"], exc_t[None])[0]
 
         F = F + ec_push[:, :M] - m_push[:, 1:].T
         Ffb = Ffb + ec_push[:, M] - t_push[M:]
@@ -423,9 +429,9 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, J, max_iter,
     return (F, Ffb, Fmt, pe, pm, pt, total_iters + iters), None
 
 
-@functools.partial(jax.jit, static_argnames=("J", "max_iter", "scale"))
+@functools.partial(jax.jit, static_argnames=("max_iter", "scale"))
 def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
-                  init_flows, init_fb, eps_sched, max_iter_total, *, J,
+                  init_flows, init_fb, eps_sched, max_iter_total, *,
                   max_iter, scale):
     """The jitted solve.  All inputs int32; shapes static.
 
@@ -475,7 +481,7 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
 
     phase = functools.partial(
         _pr_phase, C=C, U=U, Uem=Uem, supply=supply, cap=cap, total=total,
-        J=J, max_iter=max_iter, max_iter_total=max_iter_total,
+        max_iter=max_iter, max_iter_total=max_iter_total,
     )
     carry0 = (F0, Ffb0, Fmt0, pe, pm, pt, jnp.int32(0))
     (F, Ffb, Fmt, pe, pm, pt, iters), _ = lax.scan(phase, carry0, eps_sched)
@@ -680,6 +686,53 @@ def _host_finalize(flows, unsched, prices, iters, *,
     )
 
 
+def _solve_with_split_rows(costs, supply, capacity, unsched_cost, row_cap,
+                           *, arc_capacity=None, **kw) -> TransportSolution:
+    """Solve with oversized-supply EC rows split into duplicate rows.
+
+    Duplicate rows share costs/arc bounds, so an optimum of the split
+    instance merges (by summing chunk flows) into an optimum of the
+    original — the split only exists to bound per-row integer range in
+    the device kernel's full-width cumsum.
+    """
+    E, M = costs.shape
+    orig = []
+    chunks = []
+    for e in range(E):
+        s = int(supply[e])
+        n = max(1, -(-s // row_cap))
+        for k in range(n):
+            chunks.append(min(row_cap, s - k * row_cap) if s else 0)
+            orig.append(e)
+    orig_idx = np.asarray(orig, dtype=np.int64)
+    sol = solve_transport(
+        costs[orig_idx], np.asarray(chunks, dtype=np.int32), capacity,
+        unsched_cost[orig_idx],
+        arc_capacity=(
+            arc_capacity[orig_idx] if arc_capacity is not None else None
+        ),
+        **kw,
+    )
+    flows = np.zeros((E, M), dtype=np.int64)
+    np.add.at(flows, orig_idx, sol.flows.astype(np.int64))
+    unsched = np.zeros(E, dtype=np.int64)
+    np.add.at(unsched, orig_idx, sol.unsched.astype(np.int64))
+    # Warm-start prices: the first chunk represents its original row
+    # (duplicate rows have interchangeable potentials).
+    first = np.searchsorted(orig_idx, np.arange(E))
+    prices = np.concatenate(
+        [sol.prices[first], sol.prices[len(orig_idx):]]
+    ).astype(np.int32)
+    return TransportSolution(
+        flows=flows.astype(np.int32),
+        unsched=unsched.astype(np.int32),
+        prices=prices,
+        objective=sol.objective,
+        gap_bound=sol.gap_bound,
+        iterations=sol.iterations,
+    )
+
+
 def solve_transport(
     costs: np.ndarray,
     supply: np.ndarray,
@@ -691,7 +744,6 @@ def solve_transport(
     init_flows: Optional[np.ndarray] = None,
     init_unsched: Optional[np.ndarray] = None,
     eps_start: Optional[int] = None,
-    bid_ranks: int = 8,
     max_iter_per_phase: int = 8192,
     max_iter_total: Optional[int] = None,
     scale: Optional[int] = None,
@@ -730,6 +782,23 @@ def solve_transport(
             gap_bound=0.0,
             iterations=0,
         )
+    # int32 cumsum headroom for the full-width push: an EC row's total
+    # residual is bounded by (M_pad + 1) * supply_e and must stay below
+    # 2**31.  A row whose supply exceeds the bound (an equivalence class
+    # of ~130k+ identical tasks at 10k-machine scale) is split into
+    # duplicate rows with chunked supplies — identical cost rows solve
+    # to a combined optimum, so merging the chunk flows afterwards is
+    # exact.  Rare enough that warm state is simply dropped on the split
+    # rows' instance.
+    row_cap = (1 << 30) // (padded_shape(E, M)[1] + 1)
+    if int(supply.max(initial=0)) > row_cap:
+        return _solve_with_split_rows(
+            costs, supply, capacity, unsched_cost, row_cap,
+            arc_capacity=arc_capacity,
+            max_iter_per_phase=max_iter_per_phase,
+            max_iter_total=max_iter_total, scale=scale,
+            max_cost_hint=max_cost_hint,
+        )
     # Pad EC rows to a power of two (min 8) and machine columns to a
     # quarter-octave bucket (bucket_size): BOTH axes churn round to round,
     # and every distinct shape is a fresh XLA compile.  Padded rows have
@@ -758,8 +827,6 @@ def solve_transport(
         prices_p[E_pad:E_pad + M] = init_prices[E:E + M]
         prices_p[E_pad + M_pad] = init_prices[E + M]
 
-    J = max(2, min(bid_ranks, M_pad + 1))
-
     flows_p = np.zeros((E_pad, M_pad), dtype=np.int32)
     if init_flows is not None:
         flows_p[:E, :M] = init_flows
@@ -785,7 +852,7 @@ def solve_transport(
         jnp.asarray(fb_p),
         jnp.asarray(eps_sched),
         jnp.int32(max_iter_total),
-        J=J, max_iter=max_iter_per_phase, scale=int(scale),
+        max_iter=max_iter_per_phase, scale=int(scale),
     )
     flows = np.asarray(flows)[:E, :M]
     unsched = np.asarray(unsched)[:E]

@@ -8,8 +8,9 @@ is expressed the JAX-native way: lay the machine (column) axis across a
 ``jax.sharding.Mesh``, annotate the operands with ``NamedSharding``, and jit
 the very same kernel — XLA's SPMD partitioner partitions every elementwise
 op M-wise on ICI and inserts the collectives the algorithm needs
-(all-gathers for the per-row global ``top_k`` candidate selection, psums for
-the excess/termination reductions).  One kernel, one code path, any mesh.
+(scan-style prefix sums for the full-width push allocation, all-gathers
+for the per-row relabel max-reductions, psums for the excess/termination
+reductions).  One kernel, one code path, any mesh.
 
 Replaces (TPU-native): the reference scheduler's single-process C++ solver
 (reference deploy/firmament-deployment.yaml:29-31) — which has no scale-out
@@ -74,7 +75,6 @@ def solve_transport_sharded(
     init_flows: Optional[np.ndarray] = None,
     init_unsched: Optional[np.ndarray] = None,
     eps_start: Optional[int] = None,
-    bid_ranks: int = 8,
     max_iter_per_phase: int = 8192,
     max_iter_total: Optional[int] = None,
     scale: Optional[int] = None,
@@ -100,7 +100,7 @@ def solve_transport_sharded(
             costs, supply, capacity, unsched_cost, init_prices,
             arc_capacity=arc_capacity, init_flows=init_flows,
             init_unsched=init_unsched, eps_start=eps_start,
-            bid_ranks=bid_ranks, max_iter_per_phase=max_iter_per_phase,
+            max_iter_per_phase=max_iter_per_phase,
             max_iter_total=max_iter_total, scale=scale,
             max_cost_hint=max_cost_hint,
         )
@@ -150,7 +150,6 @@ def solve_transport_sharded(
     vec_m = NamedSharding(mesh, P(MACHINE_AXIS))       # [M] vectors
     repl = NamedSharding(mesh, P())                    # replicated
 
-    J = max(2, min(bid_ranks, m_pad + 1))
     if max_iter_total is None:
         max_iter_total = transport.NUM_PHASES * max_iter_per_phase
     put = jax.device_put
@@ -167,7 +166,7 @@ def solve_transport_sharded(
         put(jnp.asarray(fb_p), repl),
         put(jnp.asarray(eps_sched), repl),
         put(jnp.int32(max_iter_total), repl),
-        J=J, max_iter=max_iter_per_phase, scale=int(scale),
+        max_iter=max_iter_per_phase, scale=int(scale),
     )
 
     flows = np.asarray(flows)[:E, :M]

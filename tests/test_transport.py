@@ -283,6 +283,21 @@ def test_returned_prices_are_anchored():
     assert sol.prices.max() == 0
 
 
+def test_split_rows_exact():
+    """Oversized-supply rows (int32 cumsum headroom guard) split into
+    duplicates and merge back to the exact optimum."""
+    from poseidon_tpu.ops.transport import _solve_with_split_rows
+
+    rng = np.random.default_rng(31)
+    costs, supply, cap, unsched = random_instance(rng, 4, 6)
+    supply = (supply + 1) * 3  # ensure multi-chunk splits at row_cap=2
+    sol = _solve_with_split_rows(costs, supply, cap, unsched, 2)
+    check_solution_feasible(sol, costs, supply, cap)
+    expected = oracle.transport_objective(costs, supply, cap, unsched)
+    assert sol.objective == expected
+    assert sol.prices.shape == (4 + 6 + 1,)
+
+
 def test_bucket_size_ladder():
     from poseidon_tpu.ops.transport import bucket_size
 
