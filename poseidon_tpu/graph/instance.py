@@ -551,12 +551,34 @@ class RoundPlanner:
                 self.cost_model.max_cost(),
                 mesh_multiple=max(self.solver_devices, 1),
             )
+        from poseidon_tpu.ops.transport import padded_shape
+
+        m_pad = padded_shape(ecs_b.num_ecs, len(machine_uuids))[1]
+        mm = max(self.solver_devices, 1)
+        m_pad = -(-m_pad // mm) * mm
+        if int(ecs_b.supply.max(initial=0)) > (1 << 30) // (m_pad + 1):
+            # An oversized-supply row diverts the solver onto its
+            # row-split path, which drops warm state anyway — a "warm"
+            # attempt there would be a cold ladder starved by the tight
+            # warm budget, doomed to exhaust and retry.  Go straight
+            # cold with the full budget.
+            eps_start = None
+            prices = flows0 = unsched0 = None
+        if eps_start is None:
+            # A carried frame WITHOUT a drift-derived epsilon (the EC set
+            # churned, or incrementality is off) is net-harmful: measured
+            # at 1k machines, such warm solves ranged 1x..80x a cold
+            # solve's iterations (a full-ladder refine against stale
+            # potentials mass-saturates arcs the ladder then unwinds).
+            # Cold is uniformly fast and certified; start there.
+            prices = flows0 = unsched0 = None
 
         def run(costs, eps, p=None, f=None, u=None):
             # Policy iteration budgets (the kernel default is a pure
-            # backstop): warm attempts get a tight cap — their failure
-            # mode is the cold retry below, so burning a long budget on a
-            # misled warm start only adds latency.  Cold solves get 4x
+            # backstop): a warm attempt that has not converged within a
+            # few times a typical warm solve (~200-500 iterations) is
+            # misled — its failure mode is the cheap cold retry below, so
+            # a long warm budget only adds latency.  Cold solves get 4x
             # the largest iteration count observed at 10k-machine scale
             # (~8k), keeping worst-case device wall time under the TPU
             # runtime watchdog.
@@ -565,7 +587,7 @@ class RoundPlanner:
                 costs, ecs_b.supply, col_cap, cm.unsched_cost, p,
                 arc_capacity=cm.arc_capacity, init_flows=f,
                 init_unsched=u, eps_start=eps,
-                max_iter_total=16384 if is_warm else 32768,
+                max_iter_total=2048 if is_warm else 32768,
                 # The model's static bound pins the cost scale (a compile
                 # key) regardless of per-round cost drift.
                 max_cost_hint=self.cost_model.max_cost(),
