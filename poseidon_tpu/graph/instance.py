@@ -539,39 +539,31 @@ class RoundPlanner:
         size), drift-derived epsilon ladder, gang atomicity repair."""
         from poseidon_tpu.ops.transport import INF_COST
 
-        warm = self._warm_bands.get(band, _WarmState())
-        (prices, flows0, unsched0, prev_costs, prev_unsched,
-         full_overlap) = _remap_warm_state(
-            warm, list(ecs_b.ec_ids.tolist()), list(machine_uuids)
-        )
         eps_start = None
-        if self.incremental and full_overlap and prev_costs is not None:
-            eps_start = self._incremental_eps(
-                cm.costs, prev_costs, cm.unsched_cost, prev_unsched, prices,
-                self.cost_model.max_cost(),
-                mesh_multiple=max(self.solver_devices, 1),
+        prices = flows0 = unsched0 = None
+        if self.incremental:
+            # Warm state is only ever USED on the incremental drift path,
+            # so the (per-band, per-round) index remap is skipped outright
+            # otherwise.
+            warm = self._warm_bands.get(band, _WarmState())
+            (prices, flows0, unsched0, prev_costs, prev_unsched,
+             full_overlap) = _remap_warm_state(
+                warm, list(ecs_b.ec_ids.tolist()), list(machine_uuids)
             )
-        from poseidon_tpu.ops.transport import padded_shape
-
-        m_pad = padded_shape(ecs_b.num_ecs, len(machine_uuids))[1]
-        mm = max(self.solver_devices, 1)
-        m_pad = -(-m_pad // mm) * mm
-        if int(ecs_b.supply.max(initial=0)) > (1 << 30) // (m_pad + 1):
-            # An oversized-supply row diverts the solver onto its
-            # row-split path, which drops warm state anyway — a "warm"
-            # attempt there would be a cold ladder starved by the tight
-            # warm budget, doomed to exhaust and retry.  Go straight
-            # cold with the full budget.
-            eps_start = None
-            prices = flows0 = unsched0 = None
-        if eps_start is None:
-            # A carried frame WITHOUT a drift-derived epsilon (the EC set
-            # churned, or incrementality is off) is net-harmful: measured
-            # at 1k machines, such warm solves ranged 1x..80x a cold
-            # solve's iterations (a full-ladder refine against stale
-            # potentials mass-saturates arcs the ladder then unwinds).
-            # Cold is uniformly fast and certified; start there.
-            prices = flows0 = unsched0 = None
+            if full_overlap and prev_costs is not None:
+                eps_start = self._incremental_eps(
+                    cm.costs, prev_costs, cm.unsched_cost, prev_unsched,
+                    prices, self.cost_model.max_cost(),
+                    mesh_multiple=max(self.solver_devices, 1),
+                )
+            if eps_start is None:
+                # A carried frame WITHOUT a drift-derived epsilon (the EC
+                # set churned) is net-harmful: measured at 1k machines,
+                # such warm solves ranged 1x..80x a cold solve's
+                # iterations (a full-ladder refine against stale
+                # potentials mass-saturates arcs the ladder then
+                # unwinds).  Cold is uniformly fast and certified.
+                prices = flows0 = unsched0 = None
 
         def run(costs, eps, p=None, f=None, u=None):
             # Policy iteration budgets (the kernel default is a pure

@@ -148,12 +148,9 @@ def _greedy_push(rc, resid, excess):
     """
     admissible = (rc < 0) & (resid > 0) & (excess[:, None] > 0)
     res_at = jnp.where(admissible, resid, 0)
-    # int32 cumsum headroom: the running sum spans the whole row, so a
-    # row's total residual must stay below 2**31.  EC rows are the only
-    # risk (up to M_pad * supply_e); solve_transport splits rows whose
-    # supply exceeds the headroom bound before they reach the kernel
-    # (machine rows sum to <= total supply, the sink row to <= slots +
-    # supply).
+    # int32 cumsum headroom: every residual is bounded by its column
+    # capacity, so a row's running sum stays below total slot capacity +
+    # total supply — validated < 2**31 in _host_validate.
     before = jnp.cumsum(res_at, axis=1) - res_at
     return jnp.clip(jnp.minimum(res_at, excess[:, None] - before), 0, None)
 
@@ -528,6 +525,21 @@ def _host_validate(costs, supply, capacity, unsched_cost, scale, eps_start,
         raise ValueError(f"unscheduled costs must be <= {COST_CAP}")
     if (finite.size and finite.min() < 0) or unsched_cost.min(initial=0) < 0:
         raise ValueError("costs must be non-negative")
+    # int32 headroom for the full-width push's per-row cumsum: every
+    # residual is bounded by its column capacity (Uem <= cap_m), so the
+    # worst row sum is total column capacity plus total supply (the sink
+    # row carries both layers).  Column capacities are task slots — a
+    # cluster would need ~2 billion slots to trip this.
+    flow_mass = (
+        int(capacity.astype(np.int64).sum())
+        + int(supply.astype(np.int64).sum())
+    )
+    if flow_mass >= (1 << 31):
+        raise ValueError(
+            "total slot capacity + supply exceeds int32 flow arithmetic "
+            f"range ({flow_mass} >= 2^31); shard the instance or reduce "
+            "per-machine task slots"
+        )
 
     E, M = costs.shape
     max_raw = int(max(finite.max() if finite.size else 0,
@@ -694,58 +706,6 @@ def _host_finalize(flows, unsched, prices, iters, *,
     )
 
 
-def _solve_with_split_rows(costs, supply, capacity, unsched_cost, row_cap,
-                           *, arc_capacity=None, solver=None,
-                           **kw) -> TransportSolution:
-    """Solve with oversized-supply EC rows split into duplicate rows.
-
-    Duplicate rows share costs/arc bounds, so an optimum of the split
-    instance merges (by summing chunk flows) into an optimum of the
-    original — the split only exists to bound per-row integer range in
-    the device kernel's full-width cumsum.  ``solver`` routes the split
-    instance (default ``solve_transport``; the mesh-sharded wrapper
-    passes itself so sharded solves stay sharded).
-    """
-    if solver is None:
-        solver = solve_transport
-    E, M = costs.shape
-    orig = []
-    chunks = []
-    for e in range(E):
-        s = int(supply[e])
-        n = max(1, -(-s // row_cap))
-        for k in range(n):
-            chunks.append(min(row_cap, s - k * row_cap) if s else 0)
-            orig.append(e)
-    orig_idx = np.asarray(orig, dtype=np.int64)
-    sol = solver(
-        costs[orig_idx], np.asarray(chunks, dtype=np.int32), capacity,
-        unsched_cost[orig_idx],
-        arc_capacity=(
-            arc_capacity[orig_idx] if arc_capacity is not None else None
-        ),
-        **kw,
-    )
-    flows = np.zeros((E, M), dtype=np.int64)
-    np.add.at(flows, orig_idx, sol.flows.astype(np.int64))
-    unsched = np.zeros(E, dtype=np.int64)
-    np.add.at(unsched, orig_idx, sol.unsched.astype(np.int64))
-    # Warm-start prices: the first chunk represents its original row
-    # (duplicate rows have interchangeable potentials).
-    first = np.searchsorted(orig_idx, np.arange(E))
-    prices = np.concatenate(
-        [sol.prices[first], sol.prices[len(orig_idx):]]
-    ).astype(np.int32)
-    return TransportSolution(
-        flows=flows.astype(np.int32),
-        unsched=unsched.astype(np.int32),
-        prices=prices,
-        objective=sol.objective,
-        gap_bound=sol.gap_bound,
-        iterations=sol.iterations,
-    )
-
-
 def solve_transport(
     costs: np.ndarray,
     supply: np.ndarray,
@@ -794,23 +754,6 @@ def solve_transport(
             ),
             gap_bound=0.0,
             iterations=0,
-        )
-    # int32 cumsum headroom for the full-width push: an EC row's total
-    # residual is bounded by (M_pad + 1) * supply_e and must stay below
-    # 2**31.  A row whose supply exceeds the bound (an equivalence class
-    # of ~130k+ identical tasks at 10k-machine scale) is split into
-    # duplicate rows with chunked supplies — identical cost rows solve
-    # to a combined optimum, so merging the chunk flows afterwards is
-    # exact.  Rare enough that warm state is simply dropped on the split
-    # rows' instance.
-    row_cap = (1 << 30) // (padded_shape(E, M)[1] + 1)
-    if int(supply.max(initial=0)) > row_cap:
-        return _solve_with_split_rows(
-            costs, supply, capacity, unsched_cost, row_cap,
-            arc_capacity=arc_capacity,
-            max_iter_per_phase=max_iter_per_phase,
-            max_iter_total=max_iter_total, scale=scale,
-            max_cost_hint=max_cost_hint,
         )
     # Pad EC rows to a power of two (min 8) and machine columns to a
     # quarter-octave bucket (bucket_size): BOTH axes churn round to round,

@@ -112,21 +112,6 @@ def solve_transport_sharded(
     e_pad, m_bucket = transport.padded_shape(E, M)
     m_pad = ((m_bucket + n_dev - 1) // n_dev) * n_dev
 
-    # Same int32 cumsum-headroom guard as the single-chip wrapper (the
-    # full-width push's row sum must stay below 2**31), with the bound
-    # derived from the mesh-rounded padding.
-    row_cap = (1 << 30) // (m_pad + 1)
-    if int(supply.max(initial=0)) > row_cap:
-        import functools
-
-        return transport._solve_with_split_rows(
-            costs, supply, capacity, unsched_cost, row_cap,
-            arc_capacity=arc_capacity,
-            solver=functools.partial(solve_transport_sharded, mesh=mesh),
-            max_iter_per_phase=max_iter_per_phase,
-            max_iter_total=max_iter_total, scale=scale,
-            max_cost_hint=max_cost_hint,
-        )
     costs_p = np.full((e_pad, m_pad), INF_COST, dtype=np.int32)
     costs_p[:E, :M] = costs
     supply_p = np.zeros(e_pad, dtype=np.int32)

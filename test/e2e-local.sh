@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Real-cluster e2e driver — the analog of the reference's
+# test/e2e-poseidon-local.sh (build release -> load images -> deploy ->
+# run suite).  Requires docker + a kind cluster (https://kind.sigs.k8s.io).
+#
+# What it does:
+#   1. builds the three images (deploy/Dockerfile targets)
+#   2. loads them into the kind cluster
+#   3. applies the manifests (scheduler core, glue, metrics agent)
+#   4. submits the fixture workloads and asserts they get bound by
+#      schedulerName=poseidon
+set -euo pipefail
+cd "$(dirname "${BASH_SOURCE[0]}")/.."
+
+CLUSTER="${CLUSTER:-poseidon-e2e}"
+NS=kube-system
+
+command -v kind >/dev/null || { echo "kind not installed"; exit 1; }
+kind get clusters | grep -qx "$CLUSTER" || kind create cluster --name "$CLUSTER"
+
+./deploy/build_images.sh
+for img in firmament-tpu poseidon metrics-agent; do
+  kind load docker-image "poseidon-tpu/${img}:latest" --name "$CLUSTER"
+done
+
+kubectl apply -f deploy/firmament-tpu-deployment.yaml
+kubectl apply -f deploy/poseidon-deployment.yaml
+kubectl apply -f deploy/metrics-agent.yaml
+
+kubectl -n "$NS" rollout status deploy/firmament-tpu-scheduler --timeout=300s
+kubectl -n "$NS" rollout status deploy/poseidon --timeout=300s
+
+# Workload smoke: a bare deployment scheduled by poseidon must go Running.
+kubectl apply -f deploy/configs/nginx-deployment.yaml
+kubectl rollout status deploy/nginx-poseidon --timeout=300s
+echo "e2e: nginx-poseidon pods scheduled by poseidon:"
+kubectl get pods -l app=nginx -o wide
+
+# Throughput fixture (optional, big): uncomment to run the 1000-pod job.
+# kubectl apply -f deploy/configs/cpu_spin_1000_pods.yaml
+
+echo "e2e-local: PASS"

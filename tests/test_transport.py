@@ -283,19 +283,16 @@ def test_returned_prices_are_anchored():
     assert sol.prices.max() == 0
 
 
-def test_split_rows_exact():
-    """Oversized-supply rows (int32 cumsum headroom guard) split into
-    duplicates and merge back to the exact optimum."""
-    from poseidon_tpu.ops.transport import _solve_with_split_rows
-
-    rng = np.random.default_rng(31)
-    costs, supply, cap, unsched = random_instance(rng, 4, 6)
-    supply = (supply + 1) * 3  # ensure multi-chunk splits at row_cap=2
-    sol = _solve_with_split_rows(costs, supply, cap, unsched, 2)
-    check_solution_feasible(sol, costs, supply, cap)
-    expected = oracle.transport_objective(costs, supply, cap, unsched)
-    assert sol.objective == expected
-    assert sol.prices.shape == (4 + 6 + 1,)
+def test_flow_mass_overflow_rejected():
+    """Instances whose total slot capacity + supply would overflow the
+    full-width push's int32 cumsum are rejected with a clear error (a
+    cluster would need ~2 billion task slots to hit this)."""
+    costs = np.zeros((1, 2), dtype=np.int32)
+    supply = np.array([1], dtype=np.int32)
+    cap = np.array([1 << 30, 1 << 30], dtype=np.int32)
+    unsched = np.array([10], dtype=np.int32)
+    with pytest.raises(ValueError, match="int32 flow arithmetic"):
+        solve_transport(costs, supply, cap, unsched)
 
 
 def test_bucket_size_ladder():
