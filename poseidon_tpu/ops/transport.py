@@ -416,7 +416,10 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
         # deficits); otherwise the cheap local relabel.  Measured sweep
         # (full-wave 1k/10k, churn 10k/100k): cadence 4 beats 8/16 on the
         # heavy wave case (358 vs 412/447 iterations); disabling the
-        # update entirely does not converge in any reasonable budget.
+        # update entirely does not converge in any reasonable budget, and
+        # two stall-adaptive triggers (excess non-decreasing / <1/8
+        # progress since last update) both degenerated on real instances
+        # — trickling progress defeats the former, plateaus the latter.
         pe_new, pm_new, pt_new = lax.cond(
             it % 4 == 0, global_up, local_relabel, operand=None
         )
