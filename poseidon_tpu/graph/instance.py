@@ -209,6 +209,7 @@ class RoundPlanner:
         pod_affinity: bool = True,
         solver_devices: int = 1,
         flow_solver: str = "auction",
+        solve_mode: str = "banded",
     ) -> None:
         self.state = state
         self.cost_model = cost_model
@@ -226,6 +227,18 @@ class RoundPlanner:
         if flow_solver not in ("auction", "ssp"):
             raise ValueError(f"unknown flow_solver {flow_solver!r}")
         self.flow_solver = flow_solver
+        # solve_mode: "banded" = size-band ladder, capacity-safe by
+        # construction, one solve per band largest-first (default);
+        # "cuts" = ONE joint solve over all ECs with per-arc fit bounds,
+        # then capacity-cut repair passes (clamp arcs on overloaded
+        # machines, warm re-solve), banded fallback if the repair does
+        # not settle.  Measured: under broad contention (10k tasks on 1k
+        # machines) the repair whack-a-moles across machines and falls
+        # back every round, so "cuts" only pays off on low-contention
+        # instances — banded stays the default.
+        if solve_mode not in ("banded", "cuts"):
+            raise ValueError(f"unknown solve_mode {solve_mode!r}")
+        self.solve_mode = solve_mode
         # solver_devices > 1: machine-axis mesh sharding over ICI
         # (ops/transport_sharded.py); the mesh is built on first use.
         self.solver_devices = solver_devices
@@ -390,7 +403,10 @@ class RoundPlanner:
         metrics.num_ecs = ecs.num_ecs
 
         t_solve = time.perf_counter()
-        flows = self._solve_banded(ecs, mt, metrics)
+        if self.solve_mode == "cuts":
+            flows = self._solve_cuts(ecs, mt, metrics)
+        else:
+            flows = self._solve_banded(ecs, mt, metrics)
         metrics.solve_seconds = time.perf_counter() - t_solve
         if metrics.gap_bound == float("inf"):
             # Even the cold retry exhausted its iteration budget: the
@@ -432,6 +448,112 @@ class RoundPlanner:
         frac = np.clip(frac, 1e-12, 1.0)
         band = np.floor(-np.log(frac) / np.log(self.BAND_BASE))
         return np.clip(band, 0, self.NUM_BANDS - 1).astype(np.int64)
+
+    # Bounded repair passes for the joint-solve mode; non-settling
+    # instances fall back to the capacity-safe banded ladder.
+    MAX_CUT_PASSES = 8
+
+    def _solve_cuts(self, ecs, mt, metrics) -> np.ndarray:
+        """One joint solve over ALL ECs with per-arc fit bounds, plus
+        capacity-cut repair (solve_mode="cuts").
+
+        The transportation relaxation's machine capacity is a task
+        count, so heterogeneous ECs can jointly oversubscribe a
+        machine's CPU/RAM/NIC.  Instead of size bands, this mode solves
+        the whole instance at once (per-arc fit bounds already bound
+        each single EC) and repairs: machines whose assigned units
+        exceed a resource dimension get their arcs clamped to the
+        cheapest-first units that fit (_capacity_cuts), and the solve
+        re-runs warm.  Terminates because every pass strictly clamps at
+        least one arc below its carried flow; bounded by
+        MAX_CUT_PASSES with a banded fallback for safety.
+        """
+        from poseidon_tpu.ops.transport import UNBOUNDED_ARC_CAP
+
+        E, M = ecs.num_ecs, mt.num_machines
+        if M == 0:
+            metrics.objective = int(
+                (self.cost_model.build(ecs, mt).unsched_cost.astype(np.int64)
+                 * ecs.supply.astype(np.int64)).sum()
+            )
+            return np.zeros((E, M), dtype=np.int32)
+        cm = self.cost_model.build(ecs, mt)
+        col_cap = np.clip(
+            cm.capacity.astype(np.int64), 0, None
+        ).astype(np.int32)
+        eff_arc = (
+            cm.arc_capacity.astype(np.int32).copy()
+            if cm.arc_capacity is not None
+            else np.full((E, M), UNBOUNDED_ARC_CAP, dtype=np.int32)
+        )
+        hint = self.cost_model.max_cost()
+
+        def run(costs, eps=None, p=None, f=None, u=None):
+            return self._dispatch_solve(
+                costs, ecs.supply, col_cap, cm.unsched_cost, p,
+                arc_capacity=eff_arc, init_flows=f, init_unsched=u,
+                eps_start=eps, max_iter_total=32768, max_cost_hint=hint,
+            )
+
+        gangs = (
+            ecs.is_gang
+            if self.gang_scheduling and ecs.is_gang is not None
+            else np.zeros(E, dtype=bool)
+        )
+        effective_costs = cm.costs
+        sol = run(effective_costs)
+        iters = sol.iterations
+        settled = False
+        # One repair loop for BOTH violation classes (a gang re-solve can
+        # re-overload a machine and vice versa): each pass either clamps
+        # an overloaded machine's arcs or forbids a partially-placed gang
+        # row, then re-solves warm.  Gang forbids are monotone (at most
+        # one per gang row) so the pass budget covers them on top of the
+        # capacity passes.
+        max_passes = self.MAX_CUT_PASSES + int(gangs.sum())
+        for _ in range(max_passes):
+            cuts = self._capacity_cuts(sol.flows, ecs, mt, cm.costs)
+            if cuts:
+                for (e, m), kept in cuts.items():
+                    eff_arc[e, m] = kept
+                sol = run(
+                    effective_costs, 1, sol.prices,
+                    np.minimum(sol.flows, eff_arc), sol.unsched,
+                )
+                if sol.gap_bound == float("inf"):
+                    sol = run(effective_costs)
+            else:
+                sol, effective_costs, fired = self._forbid_partial_gangs(
+                    sol, effective_costs, cm.costs, gangs, ecs.supply, run
+                )
+                if not fired:
+                    settled = True
+                    break
+            iters += sol.iterations
+        if not settled:
+            still_cut = bool(
+                self._capacity_cuts(sol.flows, ecs, mt, cm.costs)
+            )
+            placed = sol.flows.sum(axis=1)
+            still_gang = bool(
+                (gangs & (placed > 0) & (placed < ecs.supply)).any()
+            )
+            if still_cut or still_gang:
+                # Pathological oscillation: the capacity-safe ladder wins.
+                log.warning(
+                    "joint-solve repair did not settle in %d passes; "
+                    "falling back to banded solve", max_passes,
+                )
+                flows = self._solve_banded(ecs, mt, metrics)
+                # The abandoned joint-solve work still happened: keep the
+                # telemetry honest.
+                metrics.iterations += iters
+                return flows
+
+        metrics.objective = sol.objective
+        metrics.gap_bound = sol.gap_bound
+        metrics.iterations = iters
+        return sol.flows
 
     def _solve_banded(self, ecs, mt, metrics) -> np.ndarray:
         """The round's solve: size-banded transportation with committed
@@ -537,8 +659,6 @@ class RoundPlanner:
         """One band's solve: warm-started (per-band frames are stable
         across rounds because the band of an EC is a function of its
         size), drift-derived epsilon ladder, gang atomicity repair."""
-        from poseidon_tpu.ops.transport import INF_COST
-
         eps_start = None
         prices = flows0 = unsched0 = None
         if self.incremental:
@@ -591,8 +711,6 @@ class RoundPlanner:
             # churn, or a poisoned carried frame): retry cold full ladder.
             sol = run(cm.costs, None)
 
-        # Gang atomicity: forbid partially-placed gang rows, re-solve warm
-        # (each pass permanently forbids >= 1 row, so this terminates).
         effective_costs = cm.costs
         if (
             self.gang_scheduling
@@ -600,20 +718,12 @@ class RoundPlanner:
             and ecs_b.is_gang.any()
         ):
             for _ in range(int(ecs_b.is_gang.sum())):
-                placed = sol.flows.sum(axis=1)
-                partial = (
-                    ecs_b.is_gang & (placed > 0) & (placed < ecs_b.supply)
+                sol, effective_costs, fired = self._forbid_partial_gangs(
+                    sol, effective_costs, cm.costs, ecs_b.is_gang,
+                    ecs_b.supply, run,
                 )
-                if not partial.any():
+                if not fired:
                     break
-                if effective_costs is cm.costs:
-                    effective_costs = cm.costs.copy()
-                effective_costs[partial] = INF_COST
-                sol = run(
-                    effective_costs, 1, sol.prices, sol.flows, sol.unsched
-                )
-                if sol.gap_bound == float("inf"):
-                    sol = run(effective_costs, None)
 
         self._warm_bands[band] = _WarmState(
             ec_ids=list(ecs_b.ec_ids.tolist()),
@@ -627,6 +737,33 @@ class RoundPlanner:
             unsched_cost=cm.unsched_cost.astype(np.int64),
         )
         return sol
+
+    @staticmethod
+    def _forbid_partial_gangs(sol, effective_costs, base_costs, gangs,
+                              supply, run):
+        """One gang-atomicity repair step: forbid currently
+        partially-placed gang rows and re-solve warm (cold retry on a
+        misled warm start).  ``run(costs, eps, prices, flows, unsched)``
+        is the caller's solve closure.  Returns ``(sol, effective_costs,
+        fired)``; ``effective_costs`` is what the final prices are
+        optimal for (forbidden rows are INF_COST there), which warm
+        frames must save.  Each firing permanently forbids >= 1 gang
+        row, so loops over this step terminate within ``gangs.sum()``
+        passes.
+        """
+        from poseidon_tpu.ops.transport import INF_COST
+
+        placed = sol.flows.sum(axis=1)
+        partial = gangs & (placed > 0) & (placed < supply)
+        if not partial.any():
+            return sol, effective_costs, False
+        if effective_costs is base_costs:
+            effective_costs = base_costs.copy()
+        effective_costs[partial] = INF_COST
+        sol = run(effective_costs, 1, sol.prices, sol.flows, sol.unsched)
+        if sol.gap_bound == float("inf"):
+            sol = run(effective_costs, None)
+        return sol, effective_costs, True
 
     @staticmethod
     def _capacity_cuts(flows, ecs, mt, costs):

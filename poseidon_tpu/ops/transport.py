@@ -58,6 +58,8 @@ COST_CAP = 1 << 14
 INF_COST = 1 << 28
 _NEG = -(1 << 30)
 _POS = 1 << 30
+# Public sentinel for "no per-arc bound" in arc_capacity inputs.
+UNBOUNDED_ARC_CAP = _POS
 
 # Warm-start price hygiene: potentials only matter up to a uniform shift,
 # so returned prices are re-anchored at max=0, and incoming warm prices are

@@ -54,6 +54,7 @@ class FirmamentServicer:
             pod_affinity=self.config.pod_affinity,
             solver_devices=self.config.solver_devices,
             flow_solver=self.config.flow_solver,
+            solve_mode=self.config.solve_mode,
         )
         # Schedule() rounds are serialized: the planner's warm-start state
         # is single-writer (the reference client also calls Schedule from
