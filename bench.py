@@ -216,6 +216,26 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
     }
 
 
+def run_trace(machines: int, tasks: int, rounds: int) -> dict:
+    """BASELINE config 5: Google-trace-shaped replay with incremental
+    delta re-solve (poseidon_tpu.replay) — churning jobs/completions
+    between rounds rather than synthetic drain/resubmit."""
+    import jax
+
+    from poseidon_tpu.replay.driver import ReplayDriver
+    from poseidon_tpu.replay.trace import synthesize_trace
+
+    events = synthesize_trace(
+        machines, max(tasks // 8, 1), horizon_s=rounds * 10.0, seed=3
+    )
+    driver = ReplayDriver(events, round_interval_s=10.0)
+    report = driver.run(max_rounds=rounds)
+    out = report.summary()
+    out["backend"] = jax.devices()[0].platform
+    out["ok"] = True
+    return out
+
+
 def run_parity() -> dict:
     """BASELINE config 1 (100 nodes / 1k pods): TPU solver objective must
     equal the exact host oracle on the same transportation instance."""
@@ -268,7 +288,8 @@ def main(argv=None) -> int:
     p.add_argument("--ecs", type=int, default=100)
     p.add_argument("--rounds", type=int, default=5)
     p.add_argument("--verbose", action="store_true")
-    p.add_argument("--child", choices=["rung", "parity"], default=None)
+    p.add_argument("--child", choices=["rung", "parity", "trace"],
+                   default=None)
     args = p.parse_args(argv)
 
     if args.child == "rung":
@@ -279,6 +300,10 @@ def main(argv=None) -> int:
     if args.child == "parity":
         _ensure_live_backend()
         print(json.dumps(run_parity()))
+        return 0
+    if args.child == "trace":
+        _ensure_live_backend()
+        print(json.dumps(run_trace(args.machines, args.tasks, args.rounds)))
         return 0
 
     # ---- parent: drive the ladder; never touches jax, always emits JSON
@@ -301,6 +326,18 @@ def main(argv=None) -> int:
 
     parity = _child("parity", [], PARITY_TIMEOUT_S)
 
+    # Trace replay (BASELINE config 5) at the largest completed rung's
+    # scale: realistic job churn with incremental re-solve.
+    trace = {"ok": False, "error": "no completed rung to size the trace"}
+    for r in reversed(rungs):
+        if r.get("ok"):
+            trace = _child("trace", [
+                "--machines", str(r["machines"]),
+                "--tasks", str(r["tasks"]),
+                "--rounds", str(max(args.rounds * 4, 12)),
+            ], RUNG_TIMEOUT_S)
+            break
+
     best = None
     for r in rungs:
         if r.get("ok"):
@@ -314,6 +351,7 @@ def main(argv=None) -> int:
         # paths: surface the whole child result, not just the bit.
         "parity_ok": parity.get("parity_ok", False),
         "parity": parity,
+        "trace": trace,
         "ladder": rungs,
     }
     if best is None:

@@ -489,10 +489,15 @@ class RoundPlanner:
         hint = self.cost_model.max_cost()
 
         def run(costs, eps=None, p=None, f=None, u=None):
+            # Same policy budgets as the banded path: tight cap on warm
+            # attempts (cold retry is the failure mode), full cold budget.
+            is_warm = p is not None or f is not None
             return self._dispatch_solve(
                 costs, ecs.supply, col_cap, cm.unsched_cost, p,
                 arc_capacity=eff_arc, init_flows=f, init_unsched=u,
-                eps_start=eps, max_iter_total=32768, max_cost_hint=hint,
+                eps_start=eps,
+                max_iter_total=2048 if is_warm else 32768,
+                max_cost_hint=hint,
             )
 
         gangs = (
@@ -500,8 +505,32 @@ class RoundPlanner:
             if self.gang_scheduling and ecs.is_gang is not None
             else np.zeros(E, dtype=bool)
         )
+        # Warm frame for the joint solve (same policy as the banded
+        # path, stored under a reserved band key): usable only with a
+        # drift-derived epsilon — a carried frame without one is
+        # measured net-harmful.
+        _CUTS_KEY = -1
+        eps_start = None
+        prices = flows0 = unsched0 = None
+        if self.incremental:
+            warm = self._warm_bands.get(_CUTS_KEY, _WarmState())
+            (prices, flows0, unsched0, prev_costs, prev_unsched,
+             full_overlap) = _remap_warm_state(
+                warm, list(ecs.ec_ids.tolist()), list(mt.uuids)
+            )
+            if full_overlap and prev_costs is not None:
+                eps_start = self._incremental_eps(
+                    cm.costs, prev_costs, cm.unsched_cost, prev_unsched,
+                    prices, self.cost_model.max_cost(),
+                    mesh_multiple=max(self.solver_devices, 1),
+                )
+            if eps_start is None:
+                prices = flows0 = unsched0 = None
+
         effective_costs = cm.costs
-        sol = run(effective_costs)
+        sol = run(effective_costs, eps_start, prices, flows0, unsched0)
+        if prices is not None and sol.gap_bound == float("inf"):
+            sol = run(effective_costs)
         iters = sol.iterations
         settled = False
         # One repair loop for BOTH violation classes (a gang re-solve can
@@ -550,6 +579,15 @@ class RoundPlanner:
                 metrics.iterations += iters
                 return flows
 
+        self._warm_bands[_CUTS_KEY] = _WarmState(
+            ec_ids=list(ecs.ec_ids.tolist()),
+            machine_uuids=list(mt.uuids),
+            prices=sol.prices,
+            flows=sol.flows,
+            unsched=sol.unsched,
+            costs=effective_costs.astype(np.int64),
+            unsched_cost=cm.unsched_cost.astype(np.int64),
+        )
         metrics.objective = sol.objective
         metrics.gap_bound = sol.gap_bound
         metrics.iterations = iters

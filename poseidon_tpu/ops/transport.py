@@ -801,7 +801,7 @@ def solve_transport(
             raise ValueError("arc_capacity must be non-negative")
         arc_p[:E, :M] = arc_capacity
     else:
-        arc_p[:E, :M] = _POS
+        arc_p[:E, :M] = UNBOUNDED_ARC_CAP
 
     if max_iter_total is None:
         max_iter_total = NUM_PHASES * max_iter_per_phase

