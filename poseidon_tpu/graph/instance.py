@@ -433,7 +433,14 @@ class RoundPlanner:
 
     # Size-band ladder: rows whose dominant resource fraction falls within
     # one factor-of-BAND_BASE band solve together; bands go largest-first.
-    BAND_BASE = 4.0
+    # Measured sweep (mixed-size workloads, uncontended AND 1.5x
+    # oversubscribed): base 8 matches base 4's objective when capacity is
+    # plentiful and strictly beats it under contention (fewer bands means
+    # small tasks share a solve with big ones and pack the gaps the
+    # per-band capacity denominator would otherwise strand), with fewer
+    # compile shapes; base 16 collapses everything into one band and
+    # strands capacity behind the largest request's denominator.
+    BAND_BASE = 8.0
     NUM_BANDS = 8
 
     def _band_of_rows(self, ecs, mt) -> np.ndarray:
