@@ -25,12 +25,15 @@ Plus ``parity_ok``: the TPU solver's objective equals the exact host
 oracle (networkx network simplex) on the 100-node/1k-pod BASELINE
 config 1 instance.
 
-Prints ONE JSON line, e.g.::
+Prints ONE JSON line PER COMPLETED STAGE (each a superset of the
+previous; consumers take the LAST line — this way a kill at any point
+still leaves a valid, maximal artifact on stdout)::
 
   {"metric": "schedule_round_s", "value": <churn p50 s>, "unit": "s",
    "vs_baseline": <1.0/value>, "machines": ..., "tasks": ...,
    "cold_s": ..., "wave_p50_s": ..., "churn_p50_s": ...,
-   "parity_ok": true, "ladder": [...per-rung results/errors...]}
+   "parity_ok": true, "trace": {...config-5 replay...},
+   "ladder": [...per-rung results/errors...]}
 
 ``value`` is the churn p50 at the largest completed rung — the
 steady-state number a production cluster actually pays every round.
@@ -49,8 +52,8 @@ import numpy as np
 
 LADDER = [(1_000, 10_000), (2_000, 20_000), (4_000, 40_000),
           (10_000, 100_000)]
-RUNG_TIMEOUT_S = 1500
-PARITY_TIMEOUT_S = 900
+RUNG_TIMEOUT_S = 900
+PARITY_TIMEOUT_S = 600
 
 
 def _ensure_live_backend() -> None:
@@ -306,11 +309,66 @@ def main(argv=None) -> int:
         print(json.dumps(run_trace(args.machines, args.tasks, args.rounds)))
         return 0
 
-    # ---- parent: drive the ladder; never touches jax, always emits JSON
+    # ---- parent: drive the stages; never touches jax, and re-emits the
+    # running JSON line after EVERY stage, so even if this process is
+    # killed mid-ladder the last line on stdout is a valid artifact for
+    # everything completed so far (a line-scanning consumer takes the
+    # final line; each line is a superset of the previous one).
     ladder = LADDER
     if args.machines:
         ladder = [(args.machines, args.tasks or 10 * args.machines)]
     rungs = []
+    parity = {"ok": False, "error": "not run"}
+    trace = {"ok": False, "error": "not run"}
+
+    def emit():
+        best = None
+        for r in rungs:
+            if r.get("ok"):
+                best = r
+        out = {
+            "metric": "schedule_round_s",
+            "unit": "s",
+            "target_machines": 10_000,
+            "target_tasks": 100_000,
+            # Parity failure and parity-harness failure are different
+            # triage paths: surface the whole child result, not the bit.
+            "parity_ok": parity.get("parity_ok", False),
+            "parity": parity,
+            "trace": trace,
+            "ladder": rungs,
+        }
+        if best is None:
+            out.update({"value": None, "vs_baseline": 0.0,
+                        "error": "no ladder rung completed"})
+        else:
+            # Headline: steady-state churn p50 at the largest completed
+            # rung — the latency a production cluster pays every round
+            # (the bit-identical warm wave would flatter; cold would
+            # double-count one-time compiles).  An unconverged rung posts
+            # no vs_baseline: budget-exhausted solves return fast but
+            # commit uncertified placements, and claiming a win on them
+            # would be dishonest.
+            value = best["churn_p50_s"]
+            honest = bool(best.get("converged"))
+            out.update({
+                "value": value,
+                "vs_baseline": (
+                    round(1.0 / value, 3) if honest and value > 0 else 0.0
+                ),
+                "converged": best.get("converged"),
+                "machines": best["machines"],
+                "tasks": best["tasks"],
+                "backend": best.get("backend"),
+                "cold_s": best["cold_s"],
+                "wave_p50_s": best["wave_p50_s"],
+                "churn_p50_s": best["churn_p50_s"],
+            })
+        print(json.dumps(out), flush=True)
+
+    emit()  # a valid (empty-ladder) line exists before any child runs
+    parity = _child("parity", [], PARITY_TIMEOUT_S)
+    emit()
     for machines, tasks in ladder:
         res = _child("rung", [
             "--machines", str(machines), "--tasks", str(tasks),
@@ -319,12 +377,11 @@ def main(argv=None) -> int:
         res.setdefault("machines", machines)
         res.setdefault("tasks", tasks)
         rungs.append(res)
+        emit()
         if not res.get("ok"):
             print(f"# rung {machines}/{tasks} failed: "
                   f"{res.get('error')}; stopping ladder", file=sys.stderr)
             break
-
-    parity = _child("parity", [], PARITY_TIMEOUT_S)
 
     # Trace replay (BASELINE config 5) at the largest completed rung's
     # scale: realistic job churn with incremental re-solve.
@@ -337,49 +394,7 @@ def main(argv=None) -> int:
                 "--rounds", str(max(args.rounds * 4, 12)),
             ], RUNG_TIMEOUT_S)
             break
-
-    best = None
-    for r in rungs:
-        if r.get("ok"):
-            best = r
-    out = {
-        "metric": "schedule_round_s",
-        "unit": "s",
-        "target_machines": 10_000,
-        "target_tasks": 100_000,
-        # Parity failure and parity-harness failure are different triage
-        # paths: surface the whole child result, not just the bit.
-        "parity_ok": parity.get("parity_ok", False),
-        "parity": parity,
-        "trace": trace,
-        "ladder": rungs,
-    }
-    if best is None:
-        out.update({"value": None, "vs_baseline": 0.0,
-                    "error": "no ladder rung completed"})
-    else:
-        # Headline: steady-state churn p50 at the largest completed rung —
-        # the latency a production cluster pays every round (the
-        # bit-identical warm wave would flatter; cold would double-count
-        # one-time compiles).  An unconverged rung posts no vs_baseline:
-        # budget-exhausted solves return fast but commit uncertified
-        # placements, and claiming a win on them would be dishonest.
-        value = best["churn_p50_s"]
-        honest = bool(best.get("converged"))
-        out.update({
-            "value": value,
-            "vs_baseline": (
-                round(1.0 / value, 3) if honest and value > 0 else 0.0
-            ),
-            "converged": best.get("converged"),
-            "machines": best["machines"],
-            "tasks": best["tasks"],
-            "backend": best.get("backend"),
-            "cold_s": best["cold_s"],
-            "wave_p50_s": best["wave_p50_s"],
-            "churn_p50_s": best["churn_p50_s"],
-        })
-    print(json.dumps(out))
+    emit()
     return 0
 
 
