@@ -293,7 +293,13 @@ class RoundPlanner:
                 costs, supply, capacity, unsched_cost, prices,
                 mesh=self._mesh, **kw,
             )
-        return solve_transport(
+        from poseidon_tpu.ops.transport import solve_transport_selective
+
+        # Sparse rounds (steady-state churn: a few hundred units against
+        # thousands of machines) solve on the cheapest-column union with
+        # a full-instance optimality certificate; dense rounds and
+        # unsound reductions fall through to the full solve inside.
+        return solve_transport_selective(
             costs, supply, capacity, unsched_cost, prices, **kw
         )
 
@@ -316,6 +322,8 @@ class RoundPlanner:
 
         if self.flow_solver == "ssp":
             return 0
+        from poseidon_tpu.ops.transport import derive_scale
+
         m_now = len(self.state.machines)
         m_buckets = sorted({
             bucket_size(m) for m in (m_now, max_machines) if m > 0
@@ -324,23 +332,59 @@ class RoundPlanner:
         rng = np.random.default_rng(0)
         compiled = 0
         e_cap, _ = padded_shape(max(max_ecs, 1), 1)
+        probe_costs = np.full((1, 1), hint, dtype=np.int32)
+        probe_unsched = np.full(1, hint, dtype=np.int32)
         for m_bucket in m_buckets:
             e_bucket = 8
             while e_bucket <= e_cap:
-                costs = rng.integers(
-                    0, hint + 1, size=(e_bucket, m_bucket)
-                ).astype(np.int32)
-                supply = np.ones(e_bucket, dtype=np.int32)
-                cap = np.ones(m_bucket, dtype=np.int32)
-                unsched = np.full(e_bucket, hint, dtype=np.int32)
-                arc = np.ones((e_bucket, m_bucket), dtype=np.int32)
-                # Budgets are traced operands, not compile keys: one
-                # solve covers both the warm and cold paths per shape.
-                self._dispatch_solve(
-                    costs, supply, cap, unsched, arc_capacity=arc,
-                    max_cost_hint=hint,
-                )
-                compiled += 1
+                # The selective (column-reduced) path solves sparse
+                # rounds at power-of-four widths below the full bucket,
+                # AT THE FULL bucket's scale (scale is a compile key and
+                # depends on BOTH padded axes): compile those exact keys
+                # too so the first churn rounds don't pay the warm-in.
+                widths = [(m_bucket, None)]
+                w = 128
+                while w * 4 < m_bucket * 3:
+                    scale_full, _ = derive_scale(
+                        probe_costs, probe_unsched, hint,
+                        *padded_shape(e_bucket, m_bucket),
+                    )
+                    widths.append((w, scale_full))
+                    w *= 4
+                for width, scale in widths:
+                    costs = rng.integers(
+                        0, hint + 1, size=(e_bucket, width)
+                    ).astype(np.int32)
+                    supply = np.ones(e_bucket, dtype=np.int32)
+                    cap = np.ones(width, dtype=np.int32)
+                    unsched = np.full(e_bucket, hint, dtype=np.int32)
+                    arc = np.ones((e_bucket, width), dtype=np.int32)
+                    # Budgets are traced operands, not compile keys: one
+                    # solve covers both warm and cold paths per shape.
+                    # Reduced widths go straight to solve_transport with
+                    # the full bucket's scale pinned — the key the
+                    # production selective path requests.  The full
+                    # bucket also bypasses the selective wrapper (its
+                    # sparse probe supply would otherwise reduce and
+                    # skip the very shape dense rounds need); the
+                    # sharded dispatch never reduces, so it keeps the
+                    # configured path.
+                    if scale is not None:
+                        solve_transport(
+                            costs, supply, cap, unsched, arc_capacity=arc,
+                            max_cost_hint=hint, scale=scale,
+                        )
+                    elif self.solver_devices > 1:
+                        self._dispatch_solve(
+                            costs, supply, cap, unsched, arc_capacity=arc,
+                            max_cost_hint=hint,
+                        )
+                    else:
+                        solve_transport(
+                            costs, supply, cap, unsched, arc_capacity=arc,
+                            max_cost_hint=hint,
+                        )
+                    compiled += 1
                 e_bucket *= 2
         return compiled
 

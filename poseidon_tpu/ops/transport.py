@@ -511,6 +511,20 @@ LADDER_FACTOR = 256
 NUM_PHASES = 5
 
 
+def derive_scale(costs, unsched_cost, max_cost_hint, num_ecs, num_machines):
+    """The cost scale a solve of this instance will run at — the single
+    source of truth shared by _host_validate (which applies it) and the
+    selective wrapper (whose full-instance certificate must use the
+    bit-identical value)."""
+    finite = costs[costs < INF_COST]
+    max_raw = int(max(finite.max() if finite.size else 0,
+                      unsched_cost.max(initial=0),
+                      max_cost_hint or 0, 1))
+    max_raw_q = 1 << (max_raw - 1).bit_length() if max_raw > 1 else 1
+    max_raw_q = min(max_raw_q, COST_CAP)
+    return choose_scale(num_ecs, num_machines, max_raw_q), max_raw_q
+
+
 def _host_validate(costs, supply, capacity, unsched_cost, scale, eps_start,
                    max_cost_hint=None):
     """Input validation + scale/epsilon-schedule derivation (host side).
@@ -547,13 +561,10 @@ def _host_validate(costs, supply, capacity, unsched_cost, scale, eps_start,
         )
 
     E, M = costs.shape
-    max_raw = int(max(finite.max() if finite.size else 0,
-                      unsched_cost.max(initial=0),
-                      max_cost_hint or 0, 1))
-    max_raw_q = 1 << (max_raw - 1).bit_length() if max_raw > 1 else 1
-    max_raw_q = min(max_raw_q, COST_CAP)
+    derived, max_raw_q = derive_scale(costs, unsched_cost, max_cost_hint,
+                                      E, M)
     if scale is None:
-        scale = choose_scale(E, M, max_raw_q)
+        scale = derived
 
     # Epsilon schedule from the (quantized) cost magnitude.  A warm
     # incremental re-solve starts the ladder at eps_start (the scaled
@@ -827,4 +838,168 @@ def solve_transport(
         costs=costs, supply=supply, capacity=capacity,
         unsched_cost=unsched_cost, scale=scale, clean=clean,
         arc_capacity=arc_capacity,
+    )
+
+
+def _lift_excluded_prices(pe, pm_sel, pt, sel, *, costs, capacity, scale):
+    """Potentials for columns excluded from a reduced solve.
+
+    An excluded column carries no flow, so its potential only has to keep
+    its residual arcs 1-optimal: ``pm <= min_e(C + pe) + 1`` (forward
+    EC->machine arcs) and ``pm >= pt - 1`` (machine->sink).  Setting
+    ``pm = max(min_e(C + pe), pt - 1)`` satisfies both whenever they are
+    jointly satisfiable; when they are not, the column was genuinely
+    attractive and the full certificate flags it (-> full-solve
+    fallback).  Vectorized over all M columns; the selected entries are
+    then overwritten with the solver's own potentials.
+    """
+    E, M = costs.shape
+    C = costs.astype(np.int64) * scale
+    cand = np.where(
+        costs < INF_COST, C + pe.astype(np.int64)[:, None], np.int64(_POS)
+    )
+    min_e = cand.min(axis=0)                      # [M]
+    pm = np.maximum(min_e, pt - 1)
+    pm = np.where(min_e >= _POS, pt, pm)          # no admissible arcs
+    pm = np.where(capacity > 0, pm, 0)            # dead columns are inert
+    pm[sel] = pm_sel
+    return np.clip(pm, _NEG // 2, _POS).astype(np.int64)
+
+
+def solve_transport_selective(
+    costs: np.ndarray,
+    supply: np.ndarray,
+    capacity: np.ndarray,
+    unsched_cost: np.ndarray,
+    init_prices: Optional[np.ndarray] = None,
+    *,
+    arc_capacity: Optional[np.ndarray] = None,
+    init_flows: Optional[np.ndarray] = None,
+    init_unsched: Optional[np.ndarray] = None,
+    slack: int = 64,
+    max_cost_hint: Optional[int] = None,
+    **kw,
+) -> TransportSolution:
+    """Column-selected solve for sparse rounds, certified on the full
+    instance.
+
+    A steady-state churn round carries a few hundred units of supply
+    against thousands of machine columns; any optimal solution only
+    touches each row's cheapest feasible columns.  This solves the
+    instance restricted to the union of every row's
+    ``supply_e + slack`` cheapest admissible columns (plus any
+    warm-flow columns), then PROVES the lifted solution optimal for the
+    FULL instance with the host reduced-cost certificate
+    (_certified_eps) — excluded columns get pricing-argument
+    potentials.  If the certificate fails (a contested cheap column
+    forced flow outside the union) or the reduction would not shrink
+    the instance, it falls back to the full solve.  Exactness is never
+    assumed: every returned gap_bound is certificate-backed.
+    """
+    costs = np.asarray(costs, dtype=np.int32)
+    supply = np.asarray(supply, dtype=np.int32)
+    capacity = np.asarray(capacity, dtype=np.int32)
+    unsched_cost = np.asarray(unsched_cost, dtype=np.int32)
+    E, M = costs.shape
+
+    def full():
+        return solve_transport(
+            costs, supply, capacity, unsched_cost, init_prices,
+            arc_capacity=arc_capacity, init_flows=init_flows,
+            init_unsched=init_unsched, max_cost_hint=max_cost_hint, **kw,
+        )
+
+    k = int(supply.max(initial=0)) + slack
+    if E == 0 or M == 0 or k >= M:
+        return full()
+    # Union of per-row cheapest-k columns (+ warm-flow columns).  Rows
+    # share their cheap columns under load-shaped costs, so the union is
+    # typically far smaller than E*k.
+    part = np.argpartition(costs, k - 1, axis=1)[:, :k]
+    mask = np.zeros(M, dtype=bool)
+    mask[part.ravel()] = True
+    if init_flows is not None:
+        mask |= np.asarray(init_flows).sum(axis=0) > 0
+    # Round the selection itself UP to a power-of-FOUR width (128, 512,
+    # 2048, ...) by adding the globally cheapest unselected columns: the
+    # union's size varies round to round, and every distinct reduced
+    # width would otherwise mint a fresh XLA compile — a coarse ladder
+    # keeps the whole steady state on one or two compiled shapes (extra
+    # columns only enlarge the union, never unsound).
+    target = 128
+    while target < int(mask.sum()):
+        target *= 4
+    if target * 4 >= M * 3:
+        return full()
+    if mask.sum() < target:
+        col_min = np.where(
+            (costs < INF_COST).any(axis=0), costs.min(axis=0), INF_COST
+        )
+        order = np.argsort(col_min, kind="stable")
+        extra = order[~mask[order]][: target - int(mask.sum())]
+        mask[extra] = True
+    sel = np.nonzero(mask)[0]
+
+    # The reduced solve runs at the FULL instance's scale so the 1/n
+    # optimality bound certifies against the full node count
+    # (derive_scale is the shared derivation — the certificate is only
+    # sound if both sides use the bit-identical value).
+    e_pad, m_pad = padded_shape(E, M)
+    scale, _ = derive_scale(costs, unsched_cost, max_cost_hint,
+                            e_pad, m_pad)
+
+    prices_r = None
+    if init_prices is not None:
+        p = np.asarray(init_prices, dtype=np.int32)
+        prices_r = np.concatenate([p[:E], p[E:E + M][sel], p[E + M:]])
+    sol_r = solve_transport(
+        costs[:, sel], supply, capacity[sel], unsched_cost, prices_r,
+        arc_capacity=(
+            arc_capacity[:, sel] if arc_capacity is not None else None
+        ),
+        init_flows=(
+            np.asarray(init_flows)[:, sel] if init_flows is not None
+            else None
+        ),
+        init_unsched=init_unsched, scale=scale,
+        max_cost_hint=max_cost_hint, **kw,
+    )
+    if sol_r.gap_bound == float("inf"):
+        return full()
+
+    flows = np.zeros((E, M), dtype=np.int32)
+    flows[:, sel] = sol_r.flows
+    pe = sol_r.prices[:E]
+    pt = int(sol_r.prices[E + sel.size])
+    pm = _lift_excluded_prices(
+        pe, sol_r.prices[E:E + sel.size].astype(np.int64), pt, sel,
+        costs=costs, capacity=capacity, scale=scale,
+    )
+    prices_full = np.concatenate([
+        pe.astype(np.int64), pm, np.int64([pt])
+    ]).astype(np.int32)
+
+    eps_actual = _certified_eps(
+        flows, sol_r.unsched, prices_full, costs=costs, supply=supply,
+        capacity=capacity, unsched_cost=unsched_cost, scale=scale,
+        arc_capacity=arc_capacity,
+    )
+    if eps_actual > 1:
+        # A column outside the union was genuinely attractive: the
+        # reduction was unsound for this instance — solve in full.  The
+        # wasted reduced-solve work stays visible in the telemetry.
+        import dataclasses
+
+        sol = full()
+        return dataclasses.replace(
+            sol, iterations=sol.iterations + sol_r.iterations
+        )
+    n = E + M + 3
+    return TransportSolution(
+        flows=flows,
+        unsched=sol_r.unsched,
+        prices=normalize_prices(prices_full),
+        objective=sol_r.objective,
+        gap_bound=0.0 if scale > n else n / float(scale),
+        iterations=sol_r.iterations,
     )

@@ -221,7 +221,8 @@ class TestRoundPlanner:
         from poseidon_tpu.graph import instance as inst
         from poseidon_tpu.ops.transport import TransportSolution
 
-        def exhausted(costs, supply, capacity, unsched_cost, *a, **kw):
+        def exhausted(self, costs, supply, capacity, unsched_cost,
+                      *a, **kw):
             E, M = np.asarray(costs).shape
             return TransportSolution(
                 flows=np.zeros((E, M), dtype=np.int32),
@@ -232,7 +233,9 @@ class TestRoundPlanner:
                 iterations=123,
             )
 
-        monkeypatch.setattr(inst, "solve_transport", exhausted)
+        monkeypatch.setattr(
+            inst.RoundPlanner, "_dispatch_solve", exhausted
+        )
         st = ClusterState()
         st.node_added(mk_machine("m-0"))
         st.task_submitted(mk_task(1))

@@ -283,6 +283,110 @@ def test_returned_prices_are_anchored():
     assert sol.prices.max() == 0
 
 
+class TestSelectiveSolve:
+    """Column-selected sparse-round solve: must be EXACT (certificate-
+    backed) in every regime — reduction sound, reduction unsound
+    (fallback), warm-started, arc-capped.  The reduced path only
+    engages for M >= ~180 (minimum width 128 plus the 3/4 guard), so
+    these instances are wide with sparse supply."""
+
+    @staticmethod
+    def _reduced_engaged(costs, supply, init_flows=None, slack=2):
+        """True iff this instance takes the reduced path (mirrors the
+        wrapper's gating), so tests can assert they exercise it."""
+        E, M = costs.shape
+        k = int(supply.max(initial=0)) + slack
+        if k >= M:
+            return False
+        part = np.argpartition(costs, k - 1, axis=1)[:, :k]
+        mask = np.zeros(M, dtype=bool)
+        mask[part.ravel()] = True
+        if init_flows is not None:
+            mask |= init_flows.sum(axis=0) > 0
+        target = 128
+        while target < int(mask.sum()):
+            target *= 4
+        return target * 4 < M * 3
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_matches_oracle(self, seed):
+        from poseidon_tpu.ops.transport import solve_transport_selective
+
+        rng = np.random.default_rng(700 + seed)
+        E, M = int(rng.integers(2, 7)), int(rng.integers(200, 320))
+        costs, supply, cap, unsched = random_instance(rng, E, M)
+        assert self._reduced_engaged(costs, supply)
+        sol = solve_transport_selective(
+            costs, supply, cap, unsched, slack=2
+        )
+        check_solution_feasible(sol, costs, supply, cap)
+        expected = oracle.transport_objective(costs, supply, cap, unsched)
+        assert sol.objective == expected, seed
+        assert sol.gap_bound == 0.0
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_contested_cheap_columns_fall_back_exactly(self, seed):
+        """Every row's cheapest-k union misses capacity the optimum
+        needs (a contested cheap tier over tiny capacities), so the
+        certificate must force the full-solve fallback — landing on the
+        oracle anyway."""
+        from poseidon_tpu.ops.transport import solve_transport_selective
+
+        rng = np.random.default_rng(800 + seed)
+        E, M = 4, 300
+        costs = np.full((E, M), 500, dtype=np.int32)
+        cheap = rng.choice(M, size=30, replace=False)
+        costs[:, cheap] = 1
+        # Mid-priced tier the optimum needs once the cheap tier fills.
+        mid = np.setdiff1d(np.arange(M), cheap)[:200]
+        costs[:, mid[100:]] = 50
+        supply = np.full(E, 60, dtype=np.int32)
+        cap = np.ones(M, dtype=np.int32)
+        unsched = np.full(E, 2000, dtype=np.int32)
+        assert self._reduced_engaged(costs, supply, slack=0)
+        sol = solve_transport_selective(
+            costs, supply, cap, unsched, slack=0
+        )
+        check_solution_feasible(sol, costs, supply, cap)
+        expected = oracle.transport_objective(costs, supply, cap, unsched)
+        assert sol.objective == expected, seed
+
+    def test_warm_start_with_arc_caps(self):
+        from poseidon_tpu.ops.transport import solve_transport_selective
+
+        rng = np.random.default_rng(42)
+        E, M = 5, 250
+        costs, supply, cap, unsched = random_instance(rng, E, M)
+        arc_cap = rng.integers(0, 4, size=(E, M)).astype(np.int32)
+        assert self._reduced_engaged(costs, supply, slack=4)
+        sol1 = solve_transport_selective(
+            costs, supply, cap, unsched, arc_capacity=arc_cap, slack=4
+        )
+        sol2 = solve_transport_selective(
+            costs, supply, cap, unsched, sol1.prices,
+            arc_capacity=arc_cap, init_flows=sol1.flows,
+            init_unsched=sol1.unsched, slack=4,
+        )
+        expected = oracle.transport_objective(
+            costs, supply, cap, unsched, arc_capacity=arc_cap
+        )
+        assert sol1.objective == expected
+        assert sol2.objective == expected
+
+    def test_dense_supply_falls_through(self):
+        """Supply comparable to M: no reduction, plain full solve."""
+        from poseidon_tpu.ops.transport import solve_transport_selective
+
+        rng = np.random.default_rng(9)
+        costs, supply, cap, unsched = random_instance(rng, 4, 12)
+        assert not self._reduced_engaged(costs, supply, slack=64)
+        sol = solve_transport_selective(
+            costs, supply, cap, unsched, slack=64
+        )
+        expected = oracle.transport_objective(costs, supply, cap, unsched)
+        assert sol.objective == expected
+
+
 def test_flow_mass_overflow_rejected():
     """Instances whose total slot capacity + supply would overflow the
     full-width push's int32 cumsum are rejected with a clear error (a
