@@ -31,6 +31,17 @@ class PoseidonConfig:
     scheduling_interval: float = 10.0  # seconds; config.go:120
     config_file: str = ""
 
+    def kube_version_tuple(self) -> tuple:
+        """(major, minor) — the reference fatals on malformed versions
+        (GetKubeVersion, config.go:61-72); here that is a ValueError."""
+        parts = self.kube_version.split(".")
+        try:
+            return int(parts[0]), int(parts[1])
+        except (IndexError, ValueError):
+            raise ValueError(
+                f"incorrect content in --kube-version {self.kube_version!r}"
+            ) from None
+
 
 @dataclass
 class FirmamentTPUConfig:

@@ -72,3 +72,15 @@ def test_config_strictness_and_bool_flags():
     ).gang_scheduling
     with pytest.raises(SystemExit):
         load_config(FirmamentTPUConfig, argv=["--gang-scheduling=ture"])
+
+
+def test_kube_version_parsing():
+    from poseidon_tpu.utils.config import PoseidonConfig
+    import pytest
+
+    assert PoseidonConfig(kube_version="1.28").kube_version_tuple() == (1, 28)
+    # Malformed versions fail loudly, as the reference's GetKubeVersion
+    # fatals (config.go:61-72).
+    for bad in ("latest", "1", "1.x"):
+        with pytest.raises(ValueError):
+            PoseidonConfig(kube_version=bad).kube_version_tuple()
