@@ -70,6 +70,10 @@ class RoundMetrics:
     preempted: int = 0
     migrated: int = 0
     unscheduled: int = 0
+    # Device dispatches this round: on a tunneled accelerator every solve
+    # call pays a host<->device round trip, so the count is a first-class
+    # latency term alongside iterations.
+    device_calls: int = 0
     # False when any band's solve exhausted its iteration budget even on a
     # cold retry (gap_bound is then inf and the committed placement is the
     # repaired feasible-but-suboptimal one).  Alarmed via log.error.
@@ -447,10 +451,17 @@ class RoundPlanner:
         metrics.num_ecs = ecs.num_ecs
 
         t_solve = time.perf_counter()
+        from poseidon_tpu.ops.transport import device_call_count
+
+        calls0 = device_call_count()
         if self.solve_mode == "cuts":
             flows = self._solve_cuts(ecs, mt, metrics)
         else:
             flows = self._solve_banded(ecs, mt, metrics)
+        # Counter delta, not dispatch-wrapper invocations: the selective
+        # wrapper's full-solve fallback is two real device round trips,
+        # and the host ssp path is zero.
+        metrics.device_calls = device_call_count() - calls0
         metrics.solve_seconds = time.perf_counter() - t_solve
         if metrics.gap_bound == float("inf"):
             # Even the cold retry exhausted its iteration budget: the

@@ -121,6 +121,21 @@ def choose_scale(num_ecs: int, num_machines: int,
     return int(min(n + 1, safe))
 
 
+class _Telemetry:
+    """Process-wide device-dispatch counter.
+
+    Every entry into the jitted kernel pays a host<->device round trip —
+    dominant on a tunneled accelerator — so callers (the round planner)
+    difference this counter around a round to report true dispatch counts,
+    including solves hidden inside the selective wrapper's fallback."""
+
+    device_calls = 0
+
+
+def device_call_count() -> int:
+    return _Telemetry.device_calls
+
+
 @dataclass
 class TransportSolution:
     flows: np.ndarray       # int32 [E, M] units of EC e placed on machine m
@@ -816,6 +831,7 @@ def solve_transport(
 
     if max_iter_total is None:
         max_iter_total = NUM_PHASES * max_iter_per_phase
+    _Telemetry.device_calls += 1
     flows, unsched, prices, iters, clean = _solve_device(
         jnp.asarray(costs_p), jnp.asarray(supply_p), jnp.asarray(capacity_p),
         jnp.asarray(unsched_p), jnp.asarray(arc_p),

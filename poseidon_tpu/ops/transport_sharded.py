@@ -153,6 +153,7 @@ def solve_transport_sharded(
 
     if max_iter_total is None:
         max_iter_total = transport.NUM_PHASES * max_iter_per_phase
+    transport._Telemetry.device_calls += 1
     put = jax.device_put
     flows, unsched, prices, iters, clean = _solve_device(
         put(jnp.asarray(costs_p), col),

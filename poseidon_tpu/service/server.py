@@ -228,6 +228,12 @@ def main(argv=None) -> None:
         level=logging.INFO,
         format="%(asctime)s %(levelname).1s %(name)s] %(message)s",
     )
+    from poseidon_tpu.utils.envutil import enable_compilation_cache
+
+    # Service restarts must not repeat the compile storm (the reference's
+    # restart posture is rebuild-from-watch, SURVEY.md section 5 — ours
+    # additionally recovers the compiled kernels from the on-disk cache).
+    enable_compilation_cache()
     cfg = load_config(FirmamentTPUConfig, argv=argv)
     server = FirmamentTPUServer(config=cfg).start()
     stop = threading.Event()

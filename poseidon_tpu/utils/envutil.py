@@ -62,6 +62,40 @@ def probe_device_count(timeout: float = 120.0) -> int:
     return -1
 
 
+def enable_compilation_cache(path: Optional[str] = None) -> None:
+    """Point jax at a persistent on-disk compilation cache.
+
+    Solver kernels are compiled per (padded shape, scale) key; without a
+    persistent cache every fresh process (bench rung children, service
+    restarts, the trace-replay child) pays the full compile storm again.
+    Safe to call before or after ``import jax`` as long as no backend has
+    been used yet; honors an operator-set JAX_COMPILATION_CACHE_DIR.
+    """
+    path = os.environ.get("JAX_COMPILATION_CACHE_DIR") or (
+        path or os.path.join(os.path.expanduser("~"), ".cache", "poseidon_tpu_jax")
+    )
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError:
+        # Unwritable home (read-only container, unset HOME): the cache is
+        # an optimization, never a startup failure.
+        return
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", path)
+    # Cache even fast compiles: the dispatch-heavy round pipeline compiles
+    # many small shapes whose costs add up per process.  The env var makes
+    # the threshold hold for jax imported later in this process AND in
+    # child processes inheriting the environment.
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
+    if sys.modules.get("jax") is not None:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]),
+        )
+
+
 def backend_initialized() -> bool:
     """True iff THIS process already has a live jax backend.
 

@@ -1,0 +1,115 @@
+"""Decompose round latency: tunnel dispatch overhead vs device compute.
+
+The production TPU is reached through a tunnel (platform "axon"), so every
+jitted call pays a host<->device network round trip on top of the device
+program.  This script measures, on whatever backend is live:
+
+1. ``dispatch_us``: round-trip of a trivial jitted op (the pure tunnel+
+   runtime floor) — p50 over N calls;
+2. ``transfer``: host->device + device->host time for the [E, M] operand
+   set a band solve ships;
+3. ``solve``: end-to-end wall time of one warm ``solve_transport`` call at
+   a churn-representative shape, plus its iteration count — giving
+   device-time-per-iteration once (1) and (2) are subtracted.
+
+Usage: python tools/profile_solver.py [--machines 1000] [--ecs 100]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def p50(xs):
+    return float(np.percentile(xs, 50))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--machines", type=int, default=1000)
+    ap.add_argument("--ecs", type=int, default=100)
+    ap.add_argument("--reps", type=int, default=20)
+    args = ap.parse_args()
+
+    # Never-hang posture: a wedged tunnel blocks the first backend touch
+    # forever, so probe in a disposable subprocess first (envutil pattern).
+    from poseidon_tpu.utils.envutil import probe_device_count
+
+    if probe_device_count(timeout=150.0) < 0:
+        print("backend unreachable (wedged tunnel?); aborting", flush=True)
+        raise SystemExit(2)
+
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({dev})", flush=True)
+
+    # 1. trivial dispatch round-trip
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((), jnp.int32)
+    f(x).block_until_ready()
+    ts = []
+    for _ in range(args.reps):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    print(f"dispatch p50: {p50(ts)*1e6:.0f} us  (min {min(ts)*1e6:.0f} us)")
+
+    # 2. operand transfer for a band-solve-sized instance
+    E, M = args.ecs, args.machines
+    rng = np.random.default_rng(0)
+    costs = rng.integers(0, 1000, size=(E, M)).astype(np.int32)
+    ts_up, ts_down = [], []
+    for _ in range(args.reps):
+        t0 = time.perf_counter()
+        d = jax.device_put(costs).block_until_ready()
+        ts_up.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        np.asarray(d)
+        ts_down.append(time.perf_counter() - t0)
+    print(f"[{E}x{M}] i32 upload p50: {p50(ts_up)*1e3:.2f} ms, "
+          f"download p50: {p50(ts_down)*1e3:.2f} ms")
+
+    # 3. one solve at churn-representative shape, warm (pre-compiled)
+    from poseidon_tpu.ops.transport import solve_transport
+
+    supply = rng.integers(1, 8, size=E).astype(np.int32)
+    capacity = rng.integers(8, 64, size=M).astype(np.int32)
+    unsched = np.full(E, 2000, dtype=np.int32)
+    sol = solve_transport(costs, supply, capacity, unsched)  # compile
+    ts = []
+    iters = sol.iterations
+    for _ in range(max(args.reps // 4, 3)):
+        t0 = time.perf_counter()
+        sol = solve_transport(costs, supply, capacity, unsched)
+        ts.append(time.perf_counter() - t0)
+    t_solve = p50(ts)
+    print(f"solve[{E}x{M}] p50: {t_solve*1e3:.1f} ms, "
+          f"iters={sol.iterations} "
+          f"(~{t_solve/max(sol.iterations,1)*1e6:.0f} us/iter incl. "
+          "dispatch+transfer)")
+
+    # 4. same solve, warm-started with its own solution (few iterations):
+    # isolates the fixed per-call cost at this shape.
+    ts = []
+    for _ in range(max(args.reps // 4, 3)):
+        t0 = time.perf_counter()
+        sol2 = solve_transport(
+            costs, supply, capacity, unsched, sol.prices,
+            init_flows=sol.flows, init_unsched=sol.unsched, eps_start=1,
+        )
+        ts.append(time.perf_counter() - t0)
+    print(f"warm-identical solve p50: {p50(ts)*1e3:.1f} ms, "
+          f"iters={sol2.iterations}  <- fixed per-call floor at this shape")
+
+
+if __name__ == "__main__":
+    main()
