@@ -197,7 +197,8 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
             print(f"# [{machines}] wave {r}: {dt:.3f}s "
                   f"solve={metrics.solve_seconds:.3f}s placed={placed} "
                   f"unsched={unsched} gap={metrics.gap_bound} "
-                  f"iters={metrics.iterations} calls={metrics.device_calls}",
+                  f"iters={metrics.iterations} bf={metrics.bf_sweeps} "
+                  f"calls={metrics.device_calls}",
                   file=sys.stderr)
 
     # Steady-state churn: replace 1% of tasks per round.
@@ -226,7 +227,8 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
         if verbose:
             print(f"# [{machines}] churn {r}: {dt:.3f}s "
                   f"solve={metrics.solve_seconds:.3f}s "
-                  f"iters={metrics.iterations} calls={metrics.device_calls}",
+                  f"iters={metrics.iterations} bf={metrics.bf_sweeps} "
+                  f"calls={metrics.device_calls}",
                   file=sys.stderr)
 
     return {

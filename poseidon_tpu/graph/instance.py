@@ -74,6 +74,10 @@ class RoundMetrics:
     # call pays a host<->device round trip, so the count is a first-class
     # latency term alongside iterations.
     device_calls: int = 0
+    # Bellman-Ford sweeps spent inside the kernel's global updates — the
+    # dominant per-iteration op-count term (tuning signal for
+    # global_update_every / bf_max).
+    bf_sweeps: int = 0
     # False when any band's solve exhausted its iteration budget even on a
     # cold retry (gap_bound is then inf and the committed placement is the
     # repaired feasible-but-suboptimal one).  Alarmed via log.error.
@@ -214,6 +218,7 @@ class RoundPlanner:
         solver_devices: int = 1,
         flow_solver: str = "auction",
         solve_mode: str = "banded",
+        global_update_every: int = 4,
     ) -> None:
         self.state = state
         self.cost_model = cost_model
@@ -247,6 +252,13 @@ class RoundPlanner:
         # (ops/transport_sharded.py); the mesh is built on first use.
         self.solver_devices = solver_devices
         self._mesh = None
+        # Global-update cadence (traced solver operand — tunable per
+        # backend without recompiles; see _pr_phase).
+        if global_update_every < 1:
+            raise ValueError(
+                f"global_update_every must be >= 1, got {global_update_every}"
+            )
+        self.global_update_every = global_update_every
         # reschedule_running=False (default, reference semantics): RUNNING
         # tasks hold reservations and stay put; each round solves only the
         # pending work — stable placements, small solves.  True re-enters
@@ -285,6 +297,7 @@ class RoundPlanner:
                 prices=np.zeros(E_b + M_b + 1, dtype=np.int32),
                 objective=obj, gap_bound=0.0, iterations=0,
             )
+        kw.setdefault("global_update_every", self.global_update_every)
         if self.solver_devices > 1:
             from poseidon_tpu.ops.transport_sharded import (
                 make_solver_mesh,
@@ -594,6 +607,7 @@ class RoundPlanner:
         if prices is not None and sol.gap_bound == float("inf"):
             sol = run(effective_costs)
         iters = sol.iterations
+        bf = sol.bf_sweeps
         settled = False
         # One repair loop for BOTH violation classes (a gang re-solve can
         # re-overload a machine and vice versa): each pass either clamps
@@ -621,6 +635,7 @@ class RoundPlanner:
                     settled = True
                     break
             iters += sol.iterations
+            bf += sol.bf_sweeps
         if not settled:
             still_cut = bool(
                 self._capacity_cuts(sol.flows, ecs, mt, cm.costs)
@@ -639,6 +654,7 @@ class RoundPlanner:
                 # The abandoned joint-solve work still happened: keep the
                 # telemetry honest.
                 metrics.iterations += iters
+                metrics.bf_sweeps += bf
                 return flows
 
         self._warm_bands[_CUTS_KEY] = _WarmState(
@@ -653,6 +669,7 @@ class RoundPlanner:
         metrics.objective = sol.objective
         metrics.gap_bound = sol.gap_bound
         metrics.iterations = iters
+        metrics.bf_sweeps += bf
         return sol.flows
 
     def _solve_banded(self, ecs, mt, metrics) -> np.ndarray:
@@ -742,6 +759,7 @@ class RoundPlanner:
             objective += sol.objective
             gap = max(gap, sol.gap_bound)
             iters += sol.iterations
+            metrics.bf_sweeps += sol.bf_sweeps
             flows_full[idx] = sol.flows
 
             fl = sol.flows.astype(np.int64)

@@ -144,44 +144,16 @@ class TransportSolution:
     objective: int          # raw-cost objective (int64 host arithmetic)
     gap_bound: float        # certified optimality gap in raw cost units
     iterations: int         # total push/relabel iterations across phases
+    bf_sweeps: int = 0      # Bellman-Ford sweeps inside global updates
 
 
-def _greedy_push(rc, resid, excess):
-    """Full-width admissible push for a batch of nodes.
-
-    rc, resid: [N, A] reduced costs / residual capacities of each node's
-    outgoing residual arcs.  excess: [N].  Pushes are allocated across ALL
-    admissible arcs (rc < 0) in arc-index order via a per-row cumsum,
-    each bounded by its residual capacity, totalling at most the node's
-    excess.  Returns the pushed amounts [N, A].
-
-    Any admissible push preserves eps-optimality, so cheapest-first
-    ordering is not required for correctness — and a top-k push bounded
-    the per-iteration drain rate so hard that a phase refine saturating a
-    wide arc layer (e.g. machine->sink at 10k machines) took O(layer/k)
-    iterations to push back (~1250 iterations per phase measured at the
-    10k-machine scale; full-width: ~35).  The cumsum also replaces the
-    top_k + scatter-add pair, cutting per-iteration cost.
-    """
-    admissible = (rc < 0) & (resid > 0) & (excess[:, None] > 0)
-    res_at = jnp.where(admissible, resid, 0)
-    # int32 cumsum headroom: every residual is bounded by its column
-    # capacity, so a row's running sum stays below total slot capacity +
-    # total supply — validated < 2**31 in _host_validate.
-    before = jnp.cumsum(res_at, axis=1) - res_at
-    return jnp.clip(jnp.minimum(res_at, excess[:, None] - before), 0, None)
-
-
-def _relabel(rc, resid, cand, excess, p, eps):
+def _relabel_to(maxcand, has_adm, excess, p, eps):
     """Relabel active nodes with no admissible arc.
 
-    cand: [N, A] relabel candidates (target potential minus arc cost).
-    New potential = max candidate - eps; strictly decreases and keeps all
-    residual reduced costs >= -eps.
+    maxcand: best relabel candidate per node (target potential minus arc
+    cost, max over residual arcs).  New potential = max candidate - eps;
+    strictly decreases and keeps every residual reduced cost >= -eps.
     """
-    has_resid = resid > 0
-    has_adm = jnp.any((rc < 0) & has_resid, axis=1)
-    maxcand = jnp.max(jnp.where(has_resid, cand, _NEG), axis=1)
     new_p = jnp.maximum(maxcand - eps, _NEG // 2)
     # Only ever move DOWN: a node already at/below the floor would get its
     # potential *raised* by the clamp, which breaks the strict-decrease
@@ -195,7 +167,7 @@ _DINF = 1 << 24  # "unreached" marker for global-update distances
 
 
 def _global_update(F, Ffb, Fmt, pe, pm, pt, exc_e, exc_m, exc_t,
-                   *, C, U, Uem, supply, cap, admissible_arcs, eps, bf_max=64):
+                   *, C, U, Uem, supply, cap, admissible_arcs, eps, bf_max):
     """Goldberg-style global price update.
 
     Computes, by Bellman-Ford over the residual graph, the shortest distance
@@ -207,7 +179,9 @@ def _global_update(F, Ffb, Fmt, pe, pm, pt, exc_e, exc_m, exc_t,
     heuristic).  Unreached nodes move by the max finite distance plus slack,
     which is safe because a residual arc from an unreached node to a reached
     one cannot exist.  If BF fails to converge within bf_max sweeps the
-    update is skipped (it is only an accelerator).
+    update is skipped (it is only an accelerator).  Returns
+    ``(pe, pm, pt, sweeps)`` — the sweep count is the kernel's dominant
+    op-count term, so it is surfaced as telemetry.
     """
     E, M = C.shape
 
@@ -253,7 +227,7 @@ def _global_update(F, Ffb, Fmt, pe, pm, pt, exc_e, exc_m, exc_t,
         )
         return d_e_new, d_m_new, d_t_new, changed, it + 1
 
-    d_e, d_m, d_t, changed, _ = lax.while_loop(
+    d_e, d_m, d_t, changed, sweeps = lax.while_loop(
         bf_cond, bf_body, (d_e0, d_m0, d_t0, jnp.bool_(True), jnp.int32(0))
     )
 
@@ -282,51 +256,9 @@ def _global_update(F, Ffb, Fmt, pe, pm, pt, exc_e, exc_m, exc_t,
     pe_new = jnp.where(ok, jnp.maximum(pe - eps * d_e, _NEG // 2), pe)
     pm_new = jnp.where(ok, jnp.maximum(pm - eps * d_m, _NEG // 2), pm)
     pt_new = jnp.where(ok, jnp.maximum(pt - eps * d_t, _NEG // 2), pt)
-    return pe_new, pm_new, pt_new
+    return pe_new, pm_new, pt_new, sweeps
 
 
-def _arc_tensors(F, Ffb, Fmt, pe, pm, pt, *, C, U, Uem, supply, cap,
-                 admissible_arcs):
-    """Reduced costs, residuals, and relabel candidates for every node class.
-
-    Single source of truth for the arc formulas used by both the push sweep
-    and the relabel sweep.  Layout per class (arcs are the columns):
-
-    - EC rows:     [machines..., fallback-to-sink]
-    - machine rows:[sink, reverse-to-ECs...]
-    - sink row:    [reverse-to-machines..., reverse-to-EC-fallback...]
-    """
-    E, M = C.shape
-    rc_em = jnp.where(admissible_arcs, C + pe[:, None] - pm[None, :], _POS)
-    rc_efb = (U + pe - pt)[:, None]
-    ec = dict(
-        rc=jnp.concatenate([rc_em, rc_efb], axis=1),
-        resid=jnp.concatenate([Uem - F, (supply - Ffb)[:, None]], axis=1),
-        cand=jnp.concatenate(
-            [jnp.where(admissible_arcs, pm[None, :] - C, _NEG), (pt - U)[:, None]],
-            axis=1,
-        ),
-    )
-    m = dict(
-        # Reverse arcs on inadmissible cells read as -_POS (very admissible),
-        # but their residual (the flow) is always zero, so both the push and
-        # the relabel mask them out via resid > 0.
-        rc=jnp.concatenate([(pm - pt)[:, None], -rc_em.T], axis=1),
-        resid=jnp.concatenate([(cap - Fmt)[:, None], F.T], axis=1),
-        cand=jnp.concatenate(
-            [
-                jnp.broadcast_to(pt, (M,))[:, None],
-                jnp.where(admissible_arcs, pe[:, None] + C, _NEG).T,
-            ],
-            axis=1,
-        ),
-    )
-    t = dict(
-        rc=jnp.concatenate([pt - pm, -rc_efb[:, 0]])[None, :],
-        resid=jnp.concatenate([Fmt, Ffb])[None, :],
-        cand=jnp.concatenate([pm, pe + U])[None, :],
-    )
-    return ec, m, t
 
 
 def _excesses(F, Ffb, Fmt, *, supply, total):
@@ -340,7 +272,7 @@ def _excesses(F, Ffb, Fmt, *, supply, total):
 
 
 def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
-              max_iter_total):
+              max_iter_total, global_every, bf_max):
     """One epsilon phase: refine the carried flows to the new eps, then
     synchronous push/relabel until every excess is zero.
 
@@ -351,11 +283,7 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
     """
     E, M = C.shape
     admissible_arcs = C < INF_COST
-    (F_in, Ffb_in, Fmt_in, pe, pm, pt, total_iters) = carry
-    arcs = functools.partial(
-        _arc_tensors, C=C, U=U, Uem=Uem, supply=supply, cap=cap,
-        admissible_arcs=admissible_arcs,
-    )
+    (F_in, Ffb_in, Fmt_in, pe, pm, pt, total_iters, total_bf) = carry
 
     # --- refinement init: restore eps-optimality at the new (smaller) eps
     # with minimal disturbance to the carried flows.  A residual forward arc
@@ -384,7 +312,7 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
         return _excesses(F, Ffb, Fmt, supply=supply, total=total)
 
     def cond(st):
-        _F, _Ffb, _Fmt, exc, _pe, _pm, _pt, it = st
+        _F, _Ffb, _Fmt, exc, _pe, _pm, _pt, it, _bf = st
         exc_e, exc_m, exc_t = exc
         active = jnp.any(exc_e > 0) | jnp.any(exc_m > 0) | (exc_t > 0)
         return (
@@ -394,65 +322,159 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
         )
 
     def body(st):
-        F, Ffb, Fmt, exc, pe, pm, pt, it = st
+        F, Ffb, Fmt, exc, pe, pm, pt, it, bf = st
         exc_e, exc_m, exc_t = exc
 
-        # === push sweep (prices frozen; opposite arcs can't both be
-        # admissible, so simultaneous updates never contest an arc) ===
-        ec, m, t = arcs(F, Ffb, Fmt, pe, pm, pt)
-        ec_push = _greedy_push(ec["rc"], ec["resid"], exc_e)
-        m_push = _greedy_push(m["rc"], m["resid"], exc_m)
-        t_push = _greedy_push(t["rc"], t["resid"], exc_t[None])[0]
+        # Price-dependent reduced costs ONCE per iteration (the push sweep
+        # freezes prices, so they serve both the push and the relabel).
+        # Everything stays in [E, M] orientation — no transposes, no
+        # concatenated per-class tensors: the fallback / sink arcs are
+        # handled as separate elementwise terms, which matters because on
+        # small arrays per-op fixed cost dominates the iteration.
+        rc_em = jnp.where(admissible_arcs, C + pe[:, None] - pm[None, :], _POS)
+        rc_fb = U + pe - pt          # [E] EC -> sink fallback arcs
+        rc_mt = pm - pt              # [M] machine -> sink arcs
 
-        F = F + ec_push[:, :M] - m_push[:, 1:].T
-        Ffb = Ffb + ec_push[:, M] - t_push[M:]
-        Fmt = Fmt + m_push[:, 0] - t_push[:M]
+        # === push sweep (prices frozen; opposite arcs can't both be
+        # admissible, so simultaneous updates never contest an arc).
+        # Pushes allocate across ALL admissible arcs in arc-index order
+        # via a cumsum, each bounded by its residual, totalling at most
+        # the node's excess: any admissible push preserves eps-optimality,
+        # and full width drains refine-saturated layers in O(1) sweeps
+        # where a top-k push took O(layer/k) (measured ~1250 -> ~35
+        # iterations per phase at 10k machines).  int32 cumsum headroom:
+        # every residual is bounded by its column capacity, so a row's
+        # running sum stays below total slot capacity + total supply —
+        # validated < 2**31 in _host_validate. ===
+
+        # EC rows: machine arcs in column order, then the fallback arc.
+        res_em = jnp.where(
+            (rc_em < 0) & (exc_e[:, None] > 0), Uem - F, 0
+        )
+        before = jnp.cumsum(res_em, axis=1) - res_em
+        ec_push = jnp.clip(
+            jnp.minimum(res_em, exc_e[:, None] - before), 0, None
+        )
+        left_e = exc_e - jnp.sum(ec_push, axis=1)
+        fb_push = jnp.where(
+            (rc_fb < 0) & (left_e > 0),
+            jnp.minimum(supply - Ffb, left_e), 0,
+        )
+
+        # Machine rows: the sink arc first, then reverse arcs in EC order.
+        # Reverse arcs are admissible when the forward rc is positive; on
+        # inadmissible cells rc_em is _POS but the residual (the flow) is
+        # zero, so they never carry a push.
+        mt_push = jnp.where(
+            (rc_mt < 0) & (exc_m > 0), jnp.minimum(cap - Fmt, exc_m), 0
+        )
+        left_m = exc_m - mt_push
+        res_me = jnp.where((rc_em > 0) & (left_m[None, :] > 0), F, 0)
+        before_me = jnp.cumsum(res_me, axis=0) - res_me
+        me_push = jnp.clip(
+            jnp.minimum(res_me, left_m[None, :] - before_me), 0, None
+        )
+
+        # Sink row: reverse arcs to machines, then to EC fallbacks (1D).
+        res_t = jnp.where(
+            jnp.concatenate([-rc_mt, -rc_fb]) < 0,
+            jnp.concatenate([Fmt, Ffb]), 0,
+        ) * (exc_t > 0)
+        before_t = jnp.cumsum(res_t) - res_t
+        t_push = jnp.clip(jnp.minimum(res_t, exc_t - before_t), 0, None)
+
+        F = F + ec_push - me_push
+        Ffb = Ffb + fb_push - t_push[M:]
+        Fmt = Fmt + mt_push - t_push[:M]
 
         # === price sweep (flows frozen) ===
         exc = excesses(F, Ffb, Fmt)
         exc_e, exc_m, exc_t = exc
-        ec, m, t = arcs(F, Ffb, Fmt, pe, pm, pt)
 
         def local_relabel(_):
-            # Only active nodes with no admissible arc move, strictly down.
-            pe_new = _relabel(ec["rc"], ec["resid"], ec["cand"], exc_e, pe, eps)
-            pm_new = _relabel(m["rc"], m["resid"], m["cand"], exc_m, pm, eps)
-            pt_new = _relabel(
-                t["rc"], t["resid"], t["cand"], exc_t[None], pt[None], eps
+            # Only active nodes with no admissible arc move, strictly
+            # down.  Candidates = target potential minus arc cost, max
+            # over residual arcs; admissibility from the SAME rc tensors
+            # as the push, with post-push residuals.
+            res_em = Uem - F
+            has_em = res_em > 0
+            fb_open = supply - Ffb > 0
+            has_adm_e = (
+                jnp.any((rc_em < 0) & has_em, axis=1)
+                | ((rc_fb < 0) & fb_open)
+            )
+            maxcand_e = jnp.maximum(
+                jnp.max(
+                    jnp.where(has_em & admissible_arcs, pm[None, :] - C, _NEG),
+                    axis=1,
+                ),
+                jnp.where(fb_open, pt - U, _NEG),
+            )
+            pe_new = _relabel_to(maxcand_e, has_adm_e, exc_e, pe, eps)
+
+            mt_open = cap - Fmt > 0
+            has_adm_m = (
+                ((rc_mt < 0) & mt_open)
+                | jnp.any((rc_em > 0) & (F > 0), axis=0)
+            )
+            maxcand_m = jnp.maximum(
+                jnp.where(mt_open, pt, _NEG),
+                jnp.max(
+                    jnp.where((F > 0) & admissible_arcs, pe[:, None] + C, _NEG),
+                    axis=0,
+                ),
+            )
+            pm_new = _relabel_to(maxcand_m, has_adm_m, exc_m, pm, eps)
+
+            res_t = jnp.concatenate([Fmt, Ffb])
+            rc_t = jnp.concatenate([-rc_mt, -rc_fb])
+            has_adm_t = jnp.any((rc_t < 0) & (res_t > 0))
+            maxcand_t = jnp.max(
+                jnp.where(res_t > 0, jnp.concatenate([pm, pe + U]), _NEG)
+            )
+            pt_new = _relabel_to(
+                maxcand_t[None], has_adm_t[None], exc_t[None], pt[None], eps
             )[0]
-            return pe_new, pm_new, pt_new
+            return pe_new, pm_new, pt_new, jnp.int32(0)
 
         def global_up(_):
             return _global_update(
                 F, Ffb, Fmt, pe, pm, pt, exc_e, exc_m, exc_t,
                 C=C, U=U, Uem=Uem, supply=supply, cap=cap,
-                admissible_arcs=admissible_arcs, eps=eps,
+                admissible_arcs=admissible_arcs, eps=eps, bf_max=bf_max,
             )
 
-        # Every 4th sweep: global update (redirects everything at
-        # deficits); otherwise the cheap local relabel.  Measured sweep
+        # Every global_every-th sweep: global update (redirects everything
+        # at deficits); otherwise the cheap local relabel.  Measured sweep
         # (full-wave 1k/10k, churn 10k/100k): cadence 4 beats 8/16 on the
         # heavy wave case (358 vs 412/447 iterations); disabling the
         # update entirely does not converge in any reasonable budget, and
         # two stall-adaptive triggers (excess non-decreasing / <1/8
         # progress since last update) both degenerated on real instances
         # — trickling progress defeats the former, plateaus the latter.
-        pe_new, pm_new, pt_new = lax.cond(
-            it % 4 == 0, global_up, local_relabel, operand=None
+        # Cadence is a traced operand: iteration count and wall time trade
+        # off differently per backend (the BF sweeps dominate op count),
+        # so the planner can tune it without minting compile keys.
+        pe_new, pm_new, pt_new, sweeps = lax.cond(
+            it % global_every == 0, global_up, local_relabel, operand=None
         )
 
-        return F, Ffb, Fmt, exc, pe_new, pm_new, pt_new, it + 1
+        return F, Ffb, Fmt, exc, pe_new, pm_new, pt_new, it + 1, bf + sweeps
 
     exc0 = excesses(F, Ffb, Fmt)
-    init = (F, Ffb, Fmt, exc0, pe, pm, pt, jnp.int32(0))
-    F, Ffb, Fmt, _exc, pe, pm, pt, iters = lax.while_loop(cond, body, init)
-    return (F, Ffb, Fmt, pe, pm, pt, total_iters + iters), None
+    init = (F, Ffb, Fmt, exc0, pe, pm, pt, jnp.int32(0), jnp.int32(0))
+    F, Ffb, Fmt, _exc, pe, pm, pt, iters, bf = lax.while_loop(
+        cond, body, init
+    )
+    return (
+        F, Ffb, Fmt, pe, pm, pt, total_iters + iters, total_bf + bf
+    ), None
 
 
 @functools.partial(jax.jit, static_argnames=("max_iter", "scale"))
 def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
-                  init_flows, init_fb, eps_sched, max_iter_total, *,
-                  max_iter, scale):
+                  init_flows, init_fb, eps_sched, max_iter_total,
+                  global_every, bf_max, *, max_iter, scale):
     """The jitted solve.  All inputs int32; shapes static.
 
     costs: [E, M] raw costs (INF_COST where inadmissible)
@@ -465,11 +487,16 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
     eps_sched: [num_phases] epsilon schedule, descending to 1
     max_iter_total: scalar int32, traced (budgets differ warm vs cold and
       must not mint separate compile keys)
+    global_every / bf_max: scalar int32, traced — global-update cadence and
+      Bellman-Ford sweep cap (tuning knobs; values must not mint compile
+      keys)
 
-    Returns ``(F, Ffb, prices, iters, clean)``: ``clean`` is True iff the
-    final state has zero excess everywhere — the exact device-side
-    convergence certificate (budget exhaustion can leave states that look
-    feasible to host-side repair checks yet aborted mid-ladder).
+    Returns ``(F, Ffb, prices, iters, bf_sweeps, clean)``: ``clean`` is
+    True iff the final state has zero excess everywhere — the exact
+    device-side convergence certificate (budget exhaustion can leave
+    states that look feasible to host-side repair checks yet aborted
+    mid-ladder).  ``bf_sweeps`` totals the global updates' Bellman-Ford
+    sweeps — the kernel's dominant per-iteration op-count term.
     """
     E, M = costs.shape
     C = jnp.where(costs >= INF_COST, INF_COST, costs * scale).astype(jnp.int32)
@@ -502,15 +529,18 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
     phase = functools.partial(
         _pr_phase, C=C, U=U, Uem=Uem, supply=supply, cap=cap, total=total,
         max_iter=max_iter, max_iter_total=max_iter_total,
+        global_every=global_every, bf_max=bf_max,
     )
-    carry0 = (F0, Ffb0, Fmt0, pe, pm, pt, jnp.int32(0))
-    (F, Ffb, Fmt, pe, pm, pt, iters), _ = lax.scan(phase, carry0, eps_sched)
+    carry0 = (F0, Ffb0, Fmt0, pe, pm, pt, jnp.int32(0), jnp.int32(0))
+    (F, Ffb, Fmt, pe, pm, pt, iters, bf), _ = lax.scan(
+        phase, carry0, eps_sched
+    )
     prices = jnp.concatenate([pe, pm, pt[None]])
     exc_e, exc_m, exc_t = _excesses(F, Ffb, Fmt, supply=supply, total=total)
     clean = (
         jnp.all(exc_e == 0) & jnp.all(exc_m == 0) & (exc_t == 0)
     )
-    return F, Ffb, prices, iters, clean
+    return F, Ffb, prices, iters, bf, clean
 
 
 # The epsilon ladder always has this many phases: values are traced (no
@@ -661,7 +691,8 @@ def _certified_eps(flows, unsched, prices, *, costs, supply, capacity,
 
 def _host_finalize(flows, unsched, prices, iters, *,
                    costs, supply, capacity, unsched_cost,
-                   scale, clean=True, arc_capacity=None) -> TransportSolution:
+                   scale, clean=True, arc_capacity=None,
+                   bf_sweeps=0) -> TransportSolution:
     """Device results -> repaired, certified TransportSolution (host side).
 
     ``clean`` is the device's own convergence certificate (zero excess at
@@ -734,6 +765,7 @@ def _host_finalize(flows, unsched, prices, iters, *,
         objective=objective,
         gap_bound=gap_bound,
         iterations=int(iters),
+        bf_sweeps=int(bf_sweeps),
     )
 
 
@@ -752,6 +784,8 @@ def solve_transport(
     max_iter_total: Optional[int] = None,
     scale: Optional[int] = None,
     max_cost_hint: Optional[int] = None,
+    global_update_every: int = 4,
+    bf_max: int = 64,
 ) -> TransportSolution:
     """Solve the EC->machine transportation problem on device.
 
@@ -767,6 +801,13 @@ def solve_transport(
     never binds before the per-phase caps do — callers with latency
     budgets (the round planner) pass a tighter policy value.
     """
+    if global_update_every < 1:
+        # Reaches the kernel as a traced remainder divisor: zero would be
+        # implementation-defined on device, and no global updates at all is
+        # measured non-convergent — fail fast on the host instead.
+        raise ValueError(
+            f"global_update_every must be >= 1, got {global_update_every}"
+        )
     costs = np.asarray(costs, dtype=np.int32)
     supply = np.asarray(supply, dtype=np.int32)
     capacity = np.asarray(capacity, dtype=np.int32)
@@ -832,7 +873,7 @@ def solve_transport(
     if max_iter_total is None:
         max_iter_total = NUM_PHASES * max_iter_per_phase
     _Telemetry.device_calls += 1
-    flows, unsched, prices, iters, clean = _solve_device(
+    flows, unsched, prices, iters, bf, clean = _solve_device(
         jnp.asarray(costs_p), jnp.asarray(supply_p), jnp.asarray(capacity_p),
         jnp.asarray(unsched_p), jnp.asarray(arc_p),
         jnp.asarray(prices_p),
@@ -840,6 +881,8 @@ def solve_transport(
         jnp.asarray(fb_p),
         jnp.asarray(eps_sched),
         jnp.int32(max_iter_total),
+        jnp.int32(global_update_every),
+        jnp.int32(bf_max),
         max_iter=max_iter_per_phase, scale=int(scale),
     )
     flows = np.asarray(flows)[:E, :M]
@@ -853,7 +896,7 @@ def solve_transport(
         flows, unsched, prices_out, iters,
         costs=costs, supply=supply, capacity=capacity,
         unsched_cost=unsched_cost, scale=scale, clean=clean,
-        arc_capacity=arc_capacity,
+        arc_capacity=arc_capacity, bf_sweeps=int(bf),
     )
 
 
@@ -1008,7 +1051,8 @@ def solve_transport_selective(
 
         sol = full()
         return dataclasses.replace(
-            sol, iterations=sol.iterations + sol_r.iterations
+            sol, iterations=sol.iterations + sol_r.iterations,
+            bf_sweeps=sol.bf_sweeps + sol_r.bf_sweeps,
         )
     n = E + M + 3
     return TransportSolution(
@@ -1018,4 +1062,5 @@ def solve_transport_selective(
         objective=sol_r.objective,
         gap_bound=0.0 if scale > n else n / float(scale),
         iterations=sol_r.iterations,
+        bf_sweeps=sol_r.bf_sweeps,
     )

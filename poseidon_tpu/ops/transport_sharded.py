@@ -79,6 +79,8 @@ def solve_transport_sharded(
     max_iter_total: Optional[int] = None,
     scale: Optional[int] = None,
     max_cost_hint: Optional[int] = None,
+    global_update_every: int = 4,
+    bf_max: int = 64,
 ) -> TransportSolution:
     """Drop-in mesh-sharded variant of ``transport.solve_transport``.
 
@@ -103,6 +105,7 @@ def solve_transport_sharded(
             max_iter_per_phase=max_iter_per_phase,
             max_iter_total=max_iter_total, scale=scale,
             max_cost_hint=max_cost_hint,
+            global_update_every=global_update_every, bf_max=bf_max,
         )
 
     # Pad machines to a quarter-octave bucket rounded up to a mesh
@@ -155,7 +158,7 @@ def solve_transport_sharded(
         max_iter_total = transport.NUM_PHASES * max_iter_per_phase
     transport._Telemetry.device_calls += 1
     put = jax.device_put
-    flows, unsched, prices, iters, clean = _solve_device(
+    flows, unsched, prices, iters, bf, clean = _solve_device(
         put(jnp.asarray(costs_p), col),
         put(jnp.asarray(supply_p), repl),
         put(jnp.asarray(capacity_p), vec_m),
@@ -168,6 +171,8 @@ def solve_transport_sharded(
         put(jnp.asarray(fb_p), repl),
         put(jnp.asarray(eps_sched), repl),
         put(jnp.int32(max_iter_total), repl),
+        put(jnp.int32(global_update_every), repl),
+        put(jnp.int32(bf_max), repl),
         max_iter=max_iter_per_phase, scale=int(scale),
     )
 
@@ -182,5 +187,5 @@ def solve_transport_sharded(
         flows, unsched, prices_out, iters,
         costs=costs, supply=supply, capacity=capacity,
         unsched_cost=unsched_cost, scale=scale, clean=clean,
-        arc_capacity=arc_capacity,
+        arc_capacity=arc_capacity, bf_sweeps=int(bf),
     )
