@@ -81,6 +81,7 @@ def solve_transport_sharded(
     max_cost_hint: Optional[int] = None,
     global_update_every: int = 4,
     bf_max: int = 64,
+    greedy_init: bool = True,
 ) -> TransportSolution:
     """Drop-in mesh-sharded variant of ``transport.solve_transport``.
 
@@ -106,6 +107,7 @@ def solve_transport_sharded(
             max_iter_total=max_iter_total, scale=scale,
             max_cost_hint=max_cost_hint,
             global_update_every=global_update_every, bf_max=bf_max,
+            greedy_init=greedy_init,
         )
 
     # Pad machines to a quarter-octave bucket rounded up to a mesh
@@ -130,6 +132,12 @@ def solve_transport_sharded(
         if (arc_capacity < 0).any():
             raise ValueError("arc_capacity must be non-negative")
         arc_cap_p[:E, :M] = arc_capacity
+    # Shared cold-start policy — keeps the sharded path's bit-identical-
+    # to-single-chip property.
+    init_flows, init_unsched = transport.maybe_greedy_start(
+        greedy_init, init_flows, init_prices, init_unsched,
+        costs, supply, capacity, arc_capacity,
+    )
     flows_p = np.zeros((e_pad, m_pad), dtype=np.int32)
     if init_flows is not None:
         flows_p[:E, :M] = init_flows
@@ -158,7 +166,7 @@ def solve_transport_sharded(
         max_iter_total = transport.NUM_PHASES * max_iter_per_phase
     transport._Telemetry.device_calls += 1
     put = jax.device_put
-    flows, unsched, prices, iters, bf, clean = _solve_device(
+    flows, unsched, prices, iters, bf, clean, phase_iters = _solve_device(
         put(jnp.asarray(costs_p), col),
         put(jnp.asarray(supply_p), repl),
         put(jnp.asarray(capacity_p), vec_m),
@@ -188,4 +196,5 @@ def solve_transport_sharded(
         costs=costs, supply=supply, capacity=capacity,
         unsched_cost=unsched_cost, scale=scale, clean=clean,
         arc_capacity=arc_capacity, bf_sweeps=int(bf),
+        phase_iters=tuple(int(x) for x in np.asarray(phase_iters)),
     )
