@@ -291,9 +291,13 @@ class TestSelectiveSolve:
     these instances are wide with sparse supply."""
 
     @staticmethod
-    def _reduced_engaged(costs, supply, init_flows=None, slack=2):
+    def _reduced_engaged(costs, supply, capacity=None, init_flows=None,
+                         slack=2):
         """True iff this instance takes the reduced path (mirrors the
-        wrapper's gating), so tests can assert they exercise it."""
+        wrapper's gating, contention pre-check included), so tests can
+        assert they exercise it."""
+        from poseidon_tpu.ops.transport import INF_COST
+
         E, M = costs.shape
         k = int(supply.max(initial=0)) + slack
         if k >= M:
@@ -306,7 +310,21 @@ class TestSelectiveSolve:
         target = 128
         while target < int(mask.sum()):
             target *= 4
-        return target * 4 < M * 3
+        if target * 4 >= M * 3:
+            return False
+        if mask.sum() < target:
+            col_min = np.where(
+                (costs < INF_COST).any(axis=0), costs.min(axis=0), INF_COST
+            )
+            order = np.argsort(col_min, kind="stable")
+            extra = order[~mask[order]][: target - int(mask.sum())]
+            mask[extra] = True
+        if capacity is not None:
+            if int(supply.astype(np.int64).sum()) * 2 > int(
+                capacity.astype(np.int64)[mask].sum()
+            ):
+                return False
+        return True
 
     @pytest.mark.parametrize("seed", range(6))
     def test_matches_oracle(self, seed):
@@ -315,7 +333,7 @@ class TestSelectiveSolve:
         rng = np.random.default_rng(700 + seed)
         E, M = int(rng.integers(2, 7)), int(rng.integers(200, 320))
         costs, supply, cap, unsched = random_instance(rng, E, M)
-        assert self._reduced_engaged(costs, supply)
+        assert self._reduced_engaged(costs, supply, cap)
         sol = solve_transport_selective(
             costs, supply, cap, unsched, slack=2
         )
@@ -327,9 +345,9 @@ class TestSelectiveSolve:
     @pytest.mark.parametrize("seed", range(3))
     def test_contested_cheap_columns_fall_back_exactly(self, seed):
         """Every row's cheapest-k union misses capacity the optimum
-        needs (a contested cheap tier over tiny capacities), so the
-        certificate must force the full-solve fallback — landing on the
-        oracle anyway."""
+        needs (a contested cheap tier over tiny capacities).  The
+        contention pre-check (union capacity < 2x supply) now skips the
+        doomed reduction outright — still landing on the oracle."""
         from poseidon_tpu.ops.transport import solve_transport_selective
 
         rng = np.random.default_rng(800 + seed)
@@ -343,13 +361,52 @@ class TestSelectiveSolve:
         supply = np.full(E, 60, dtype=np.int32)
         cap = np.ones(M, dtype=np.int32)
         unsched = np.full(E, 2000, dtype=np.int32)
-        assert self._reduced_engaged(costs, supply, slack=0)
+        # The pre-check (not the certificate) rejects the reduction here.
+        assert not self._reduced_engaged(costs, supply, cap, slack=0)
         sol = solve_transport_selective(
             costs, supply, cap, unsched, slack=0
         )
         check_solution_feasible(sol, costs, supply, cap)
         expected = oracle.transport_objective(costs, supply, cap, unsched)
         assert sol.objective == expected, seed
+
+    def test_certificate_failure_falls_back_exactly(self):
+        """The certificate-fallback path proper: union capacity is ample
+        (pre-check passes) but one row's cheap-BY-COST columns are all
+        arc-capped to zero, so its usable columns live OUTSIDE the
+        cost-derived union — the lifted certificate must fail and force
+        the full-solve fallback, landing on the oracle with out-of-union
+        flow."""
+        from poseidon_tpu.ops.transport import solve_transport_selective
+
+        E, M = 3, 400
+        costs = np.zeros((E, M), dtype=np.int32)
+        costs[:, :94] = 10
+        costs[:, 94:] = 100 + np.arange(M - 94, dtype=np.int32)
+        supply = np.array([5, 30, 30], dtype=np.int32)
+        cap = np.full(M, 10, dtype=np.int32)
+        unsched = np.full(E, 2000, dtype=np.int32)
+        # Row 0 cannot actually use any column the union will contain
+        # (rows select 0..93 by cost; padding adds 94..127 by col_min),
+        # but its columns from 166 on are open and far cheaper than
+        # going unscheduled.
+        arc_cap = np.full((E, M), 1 << 20, dtype=np.int32)
+        arc_cap[0, :166] = 0
+        # The selection gating itself passes (capacity is ample).
+        assert self._reduced_engaged(costs, supply, cap, slack=0)
+        sol = solve_transport_selective(
+            costs, supply, cap, unsched, arc_capacity=arc_cap, slack=0
+        )
+        check_solution_feasible(sol, costs, supply, cap)
+        expected = oracle.transport_objective(
+            costs, supply, cap, unsched, arc_capacity=arc_cap
+        )
+        assert sol.objective == expected
+        assert sol.gap_bound == 0.0
+        # Row 0's flow really is outside the union — only the fallback
+        # full solve can have produced it.
+        assert sol.flows[0, 166:].sum() == 5
+        assert sol.flows[0, :166].sum() == 0
 
     def test_warm_start_with_arc_caps(self):
         from poseidon_tpu.ops.transport import solve_transport_selective
@@ -358,7 +415,7 @@ class TestSelectiveSolve:
         E, M = 5, 250
         costs, supply, cap, unsched = random_instance(rng, E, M)
         arc_cap = rng.integers(0, 4, size=(E, M)).astype(np.int32)
-        assert self._reduced_engaged(costs, supply, slack=4)
+        assert self._reduced_engaged(costs, supply, cap, slack=4)
         sol1 = solve_transport_selective(
             costs, supply, cap, unsched, arc_capacity=arc_cap, slack=4
         )
