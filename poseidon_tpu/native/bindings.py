@@ -69,6 +69,10 @@ def _load():
         lib.gc_task_set_state.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int32
         ]
+        lib.gc_task_place_batch.restype = ctypes.c_int64
+        lib.gc_task_place_batch.argtypes = [
+            ctypes.c_void_p, u64p, u64p, ctypes.c_int64,
+        ]
         lib.gc_task_place.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64
         ]
@@ -141,6 +145,20 @@ class NativeGraphCore:
 
     def task_place(self, uid, machine_key) -> None:
         self._lib.gc_task_place(self._h, uid, machine_key)
+
+    def task_place_batch(self, uids: np.ndarray, machine_keys: np.ndarray):
+        """Batched placement commit (one C call for a whole round)."""
+        uids = np.ascontiguousarray(uids, dtype=np.uint64)
+        keys = np.ascontiguousarray(machine_keys, dtype=np.uint64)
+        if uids.shape != keys.shape:
+            raise ValueError(
+                f"uids/machine_keys length mismatch: {uids.shape} vs "
+                f"{keys.shape}"
+            )
+        return int(self._lib.gc_task_place_batch(
+            self._h, _ptr(uids, ctypes.c_uint64),
+            _ptr(keys, ctypes.c_uint64), uids.shape[0],
+        ))
 
     # ---------------------------------------------------------------- view
 

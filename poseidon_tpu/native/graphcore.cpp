@@ -147,6 +147,31 @@ int gc_task_place(void* h, uint64_t uid, uint64_t machine) {
   return 0;
 }
 
+// Batched placement commit: the initial wave places 100k tasks in one
+// round, and a ctypes call per task dominates the commit.  Unknown uids
+// are skipped (same semantics as the scalar call's -1).  Returns the
+// number applied.
+int64_t gc_task_place_batch(void* h, const uint64_t* uids,
+                            const uint64_t* machines, int64_t n) {
+  Core* c = static_cast<Core*>(h);
+  int64_t applied = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    auto it = c->tasks.find(uids[i]);
+    if (it == c->tasks.end()) continue;
+    Task& t = it->second;
+    t.machine = machines[i];
+    if (machines[i] == 0) {
+      t.state = kRunnable;
+      t.wait += 1;
+    } else {
+      t.state = kRunning;
+      t.wait = 0;
+    }
+    ++applied;
+  }
+  return applied;
+}
+
 // ----------------------------------------------------------------- view
 
 // Builds the round view in scratch buffers.  machine_keys_sorted is the
