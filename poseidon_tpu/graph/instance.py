@@ -571,7 +571,7 @@ class RoundPlanner:
                 costs, ecs.supply, col_cap, cm.unsched_cost, p,
                 arc_capacity=eff_arc, init_flows=f, init_unsched=u,
                 eps_start=eps,
-                max_iter_total=2048 if is_warm else 32768,
+                max_iter_total=2048 if is_warm else 8192,
                 max_cost_hint=hint,
             )
 
@@ -657,15 +657,19 @@ class RoundPlanner:
                 metrics.bf_sweeps += bf
                 return flows
 
-        self._warm_bands[_CUTS_KEY] = _WarmState(
-            ec_ids=list(ecs.ec_ids.tolist()),
-            machine_uuids=list(mt.uuids),
-            prices=sol.prices,
-            flows=sol.flows,
-            unsched=sol.unsched,
-            costs=effective_costs.astype(np.int64),
-            unsched_cost=cm.unsched_cost.astype(np.int64),
-        )
+        if sol.gap_bound != float("inf"):
+            self._warm_bands[_CUTS_KEY] = _WarmState(
+                ec_ids=list(ecs.ec_ids.tolist()),
+                machine_uuids=list(mt.uuids),
+                prices=sol.prices,
+                flows=sol.flows,
+                unsched=sol.unsched,
+                costs=effective_costs.astype(np.int64),
+                unsched_cost=cm.unsched_cost.astype(np.int64),
+            )
+        else:
+            # No usable dual structure in a budget-exhausted state.
+            self._warm_bands.pop(_CUTS_KEY, None)
         metrics.objective = sol.objective
         metrics.gap_bound = sol.gap_bound
         metrics.iterations = iters
@@ -808,16 +812,20 @@ class RoundPlanner:
             # backstop): a warm attempt that has not converged within a
             # few times a typical warm solve (~200-500 iterations) is
             # misled — its failure mode is the cheap cold retry below, so
-            # a long warm budget only adds latency.  Cold solves get 4x
-            # the largest iteration count observed at 10k-machine scale
-            # (~8k), keeping worst-case device wall time under the TPU
-            # runtime watchdog.
+            # a long warm budget only adds latency.  Cold solves get
+            # >10x the largest post-ladder-tuning iteration count
+            # observed at 10k-machine scale (673, the 10k/100k CPU wave
+            # in docs/PERF.md), keeping worst-case device wall time
+            # (~30 s at measured TPU per-iteration cost) well under the
+            # TPU runtime watchdog.  A cold solve that still exhausts
+            # this commits repaired-feasible flows with gap_bound=inf:
+            # converged=False + log.error alarm, no warm frame saved.
             is_warm = p is not None or f is not None
             return self._dispatch_solve(
                 costs, ecs_b.supply, col_cap, cm.unsched_cost, p,
                 arc_capacity=cm.arc_capacity, init_flows=f,
                 init_unsched=u, eps_start=eps,
-                max_iter_total=2048 if is_warm else 32768,
+                max_iter_total=2048 if is_warm else 8192,
                 # The model's static bound pins the cost scale (a compile
                 # key) regardless of per-round cost drift.
                 max_cost_hint=self.cost_model.max_cost(),
@@ -843,17 +851,22 @@ class RoundPlanner:
                 if not fired:
                     break
 
-        self._warm_bands[band] = _WarmState(
-            ec_ids=list(ecs_b.ec_ids.tolist()),
-            machine_uuids=list(machine_uuids),
-            prices=sol.prices,
-            flows=sol.flows,
-            unsched=sol.unsched,
-            # The saved frame must be the costs the final prices are
-            # optimal for (gang repair may have forbidden rows).
-            costs=effective_costs.astype(np.int64),
-            unsched_cost=cm.unsched_cost.astype(np.int64),
-        )
+        if sol.gap_bound != float("inf"):
+            self._warm_bands[band] = _WarmState(
+                ec_ids=list(ecs_b.ec_ids.tolist()),
+                machine_uuids=list(machine_uuids),
+                prices=sol.prices,
+                flows=sol.flows,
+                unsched=sol.unsched,
+                # The saved frame must be the costs the final prices are
+                # optimal for (gang repair may have forbidden rows).
+                costs=effective_costs.astype(np.int64),
+                unsched_cost=cm.unsched_cost.astype(np.int64),
+            )
+        else:
+            # A budget-exhausted state has no usable dual structure:
+            # carrying it would poison the next round's warm attempt.
+            self._warm_bands.pop(band, None)
         return sol
 
     @staticmethod

@@ -86,11 +86,13 @@ class FirmamentServicer:
         log.info(
             "round %d: %d tasks / %d ECs / %d machines -> "
             "%d place %d preempt %d migrate %d unsched; "
-            "solve %.3fs total %.3fs objective %d",
+            "solve %.3fs total %.3fs objective %d "
+            "(iters %d, bf %d, device calls %d)",
             metrics.round_index, metrics.num_tasks, metrics.num_ecs,
             metrics.num_machines, metrics.placed, metrics.preempted,
             metrics.migrated, metrics.unscheduled, metrics.solve_seconds,
             metrics.total_seconds, metrics.objective,
+            metrics.iterations, metrics.bf_sweeps, metrics.device_calls,
         )
         return converters.deltas_to_proto(deltas)
 

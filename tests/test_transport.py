@@ -444,6 +444,32 @@ class TestSelectiveSolve:
         assert sol.objective == expected
 
 
+@pytest.mark.parametrize("seed", range(5))
+def test_greedy_flows_always_feasible(seed):
+    """The cold-start initializer must respect supply, column capacity,
+    arc capacity, and admissibility for any instance shape."""
+    from poseidon_tpu.ops.transport import INF_COST, greedy_flows
+
+    rng = np.random.default_rng(4000 + seed)
+    E, M = int(rng.integers(1, 12)), int(rng.integers(1, 60))
+    costs = rng.integers(0, 500, size=(E, M)).astype(np.int32)
+    costs[rng.random((E, M)) < 0.2] = INF_COST
+    supply = rng.integers(0, 30, size=E).astype(np.int32)
+    capacity = rng.integers(0, 8, size=M).astype(np.int32)
+    arc_cap = rng.integers(0, 5, size=(E, M)).astype(np.int32)
+    F = greedy_flows(costs, supply, capacity, arc_cap)
+    assert (F >= 0).all()
+    assert (F <= arc_cap).all()
+    assert (F.sum(axis=1) <= supply).all()
+    assert (F.sum(axis=0) <= capacity).all()
+    assert (F[costs >= INF_COST] == 0).all()
+    # Without arc caps the admissibility rule still holds.
+    F2 = greedy_flows(costs, supply, capacity)
+    assert (F2[costs >= INF_COST] == 0).all()
+    assert (F2.sum(axis=0) <= capacity).all()
+    assert (F2.sum(axis=1) <= supply).all()
+
+
 def test_flow_mass_overflow_rejected():
     """Instances whose total slot capacity + supply would overflow the
     full-width push's int32 cumsum are rejected with a clear error (a
