@@ -979,6 +979,7 @@ class RoundPlanner:
         from poseidon_tpu.ops.transport import (
             COST_CAP,
             INF_COST,
+            LADDER_FACTOR,
             choose_scale,
             padded_shape,
         )
@@ -1030,6 +1031,17 @@ class RoundPlanner:
             )
             worst = int((-rc[fresh]).max(initial=0))
             eps = max(eps, worst + 1)
+        # Only worth it if the warm ladder skips at least one rung of the
+        # cold one: measured at 10k-machine churn, freed capacity makes
+        # newly admissible arcs drive eps to within a factor ~7 of the
+        # cold eps0 (one rung = LADDER_FACTOR = 4096), and a warm solve
+        # from there with stale flows ran 700-1400 iterations where the
+        # cold greedy start takes ~100-300.  The one-scale-unit floor
+        # keeps bit-identical and tiny-drift rounds (eps ~ scale) on the
+        # fast path even for narrow cost ranges (small max_raw_q).
+        eps0_cold = max_raw_q * scale // 2
+        if eps > max(scale, eps0_cold // LADDER_FACTOR):
+            return None
         return eps
 
     # -------------------------------------------------------------- assignment

@@ -691,25 +691,124 @@ def greedy_flows(costs, supply, capacity, arc_capacity=None) -> np.ndarray:
 
 
 def maybe_greedy_start(greedy_init, init_flows, init_prices, init_unsched,
-                       costs, supply, capacity, arc_capacity):
+                       eps_start, costs, supply, capacity, arc_capacity,
+                       unsched_cost, max_cost_hint, e_pad, m_pad,
+                       scale=None):
     """Shared cold-start policy for both solver wrappers.
 
     One definition on purpose: the sharded wrapper's bit-identical-to-
     single-chip property depends on both paths deriving the same initial
-    state.  Returns ``(init_flows, init_unsched)`` unchanged unless this
-    is a true cold solve (no warm state at all) with greedy_init on.
+    state.  Returns ``(init_flows, init_unsched, init_prices,
+    eps_start)`` unchanged unless this is a true cold solve (no warm
+    state at all) with greedy_init on.
+
+    A greedy flow alone is useless past the first epsilon phase: with
+    zero prices every loaded arc has rc = C*scale > eps, so the next
+    refine empties it all.  The fix is the flow's own AUCTION DUALS —
+    pe[e] = -scale * (row e's marginal cost: its most expensive greedy
+    arc, or its unscheduled cost if greedy left units over), pm = pt = 0
+    (machines with spare sink capacity price at the sink's potential) —
+    under which every loaded arc has rc <= 0 and survives refines.  The
+    ladder then starts at the worst remaining dual violation (cheap
+    residual arcs another row contested away, or marginals above the
+    fallback): small for sparse rounds, where the solve now starts
+    near-done instead of re-deriving prices from scratch.
     """
-    if (
+    if not (
         greedy_init
         and init_flows is None
         and init_prices is None
         and init_unsched is None
+        and eps_start is None
     ):
-        init_flows = greedy_flows(costs, supply, capacity, arc_capacity)
-        init_unsched = (
-            supply.astype(np.int64) - init_flows.sum(axis=1)
-        ).astype(np.int32)
-    return init_flows, init_unsched
+        return init_flows, init_unsched, init_prices, eps_start
+    E, M = costs.shape
+    init_flows = greedy_flows(costs, supply, capacity, arc_capacity)
+    leftover = (
+        supply.astype(np.int64) - init_flows.sum(axis=1)
+    )
+    init_unsched = leftover.astype(np.int32)
+
+    # The scale must be the one the solve will run at — the caller's
+    # pinned value when given (the selective wrapper pins the FULL
+    # instance's scale onto the reduced solve), else _host_validate's
+    # derivation over the padded shape.  Mispriced duals start the
+    # ladder far from the true violation.
+    d_scale, max_raw_q = derive_scale(costs, unsched_cost, max_cost_hint,
+                                      e_pad, m_pad)
+    if scale is None:
+        scale = d_scale
+    C64 = costs.astype(np.int64)
+    used = init_flows > 0
+    marginal = np.where(used, C64, -1).max(axis=1)          # [E]
+    marginal = np.where(leftover > 0, unsched_cost.astype(np.int64),
+                        marginal)
+    marginal = np.clip(marginal, 0, None)
+
+    # Machine potentials: a column whose residual arcs undercut row
+    # marginals (a machine freed below the fill frontier) prices down by
+    # that demand, bounded by the slack of its own loaded arcs (a loaded
+    # arc AT its row's marginal pins the column).  This absorbs the
+    # column-structured part of the gap — after a churn round the freed
+    # machines are cheaper than the frontier for EVERY row, which no
+    # row-potential choice can express.
+    adm = costs < INF_COST
+    Uem = np.minimum(supply.astype(np.int64)[:, None],
+                     capacity.astype(np.int64)[None, :])
+    if arc_capacity is not None:
+        Uem = np.minimum(Uem, arc_capacity.astype(np.int64))
+    resid = adm & (Uem - init_flows > 0)
+    BIG = np.int64(1) << 60
+    Cs = np.where(adm, C64 * scale, BIG)
+    has_flow = used.any(axis=1)
+    # A few rounds of alternation toward equilibrium duals.  Per column,
+    # eps-feasibility is the interval  max_loaded(Cs+pe) <= pm <=
+    # min_resid(Cs+pe): loaded arcs need rc = Cs+pe-pm <= 0, residual
+    # arcs rc >= 0.  Per row, utility re-prices against the current
+    # machine potentials.  Greedy's row-order assignment needs the
+    # alternation: an early row that hogged a freed machine pins the
+    # column's interval until the row's own utility is re-priced.
+    # Conflicting intervals (true contention) keep the loaded bound;
+    # the residual violation is then exactly what the certificate and
+    # the epsilon ladder resolve.
+    pm0 = np.zeros(M, dtype=np.int64)
+    pe0 = -scale * marginal
+    for _ in range(2):
+        q = Cs + pe0[:, None]                         # [E, M]
+        lo = np.where(used, q, -BIG).max(axis=0)      # loaded bound
+        hi = np.where(resid, q, BIG).min(axis=0)      # residual bound
+        # (Dead columns fall out as max(-BIG, min(BIG, 0)) = 0.)
+        pm0 = np.maximum(lo, np.minimum(hi, 0))
+        # Row utility: best net cost among its loaded arcs (rows without
+        # flow keep their greedy/fallback marginal).
+        net = np.where(used, Cs - pm0[None, :], BIG).min(axis=1)
+        pe0 = np.where(has_flow, -net, -scale * marginal)
+    pm0 = np.clip(pm0, -(PRICE_SPREAD_CAP - 1), PRICE_SPREAD_CAP - 1)
+    pe0 = np.clip(pe0, -(PRICE_SPREAD_CAP - 1), PRICE_SPREAD_CAP - 1)
+    # Sink potential: machines with spare sink capacity need
+    # pm - pt >= -eps, so pt sits at their minimum.
+    spare = init_flows.sum(axis=0) < capacity.astype(np.int64)
+    pt0 = int(pm0[spare].min(initial=0))
+    init_prices = np.concatenate(
+        [pe0, pm0, np.int64([pt0])]
+    ).astype(np.int32)
+
+    # The exact worst violation of these duals over every arc class —
+    # the same certificate the solver's own gap bound uses.
+    eps_g = _certified_eps(
+        init_flows, init_unsched, init_prices, costs=costs,
+        supply=supply, capacity=capacity, unsched_cost=unsched_cost,
+        scale=scale, arc_capacity=arc_capacity,
+    )
+    # Under heavy contention the residual violation approaches the cold
+    # ladder's own start and the dual perturbation only adds noise
+    # (measured: 10k-machine cold iterations DOUBLED with unconditional
+    # duals).  Use them only when they skip at least one ladder rung —
+    # with a floor of one scale unit so narrow cost ranges (small
+    # max_raw_q) never lose near-exact starts to the rung arithmetic.
+    if eps_g > max(scale, max_raw_q * scale // 2 // LADDER_FACTOR):
+        return init_flows, init_unsched, None, None
+    return init_flows, init_unsched, init_prices, eps_g
 
 
 def normalize_prices(p: np.ndarray) -> np.ndarray:
@@ -937,6 +1036,15 @@ def solve_transport(
     capacity_p = np.zeros(M_pad, dtype=np.int32)
     capacity_p[:M] = capacity
 
+    if arc_capacity is not None:
+        arc_capacity = np.asarray(arc_capacity, dtype=np.int32)
+        if (arc_capacity < 0).any():
+            raise ValueError("arc_capacity must be non-negative")
+    init_flows, init_unsched, init_prices, eps_start = maybe_greedy_start(
+        greedy_init, init_flows, init_prices, init_unsched, eps_start,
+        costs, supply, capacity, arc_capacity, unsched_cost,
+        max_cost_hint, E_pad, M_pad, scale=scale,
+    )
     scale, eps_sched = _host_validate(
         costs_p, supply_p, capacity_p, unsched_p, scale, eps_start,
         max_cost_hint,
@@ -952,17 +1060,10 @@ def solve_transport(
 
     arc_p = np.zeros((E_pad, M_pad), dtype=np.int32)
     if arc_capacity is not None:
-        arc_capacity = np.asarray(arc_capacity, dtype=np.int32)
-        if (arc_capacity < 0).any():
-            raise ValueError("arc_capacity must be non-negative")
         arc_p[:E, :M] = arc_capacity
     else:
         arc_p[:E, :M] = UNBOUNDED_ARC_CAP
 
-    init_flows, init_unsched = maybe_greedy_start(
-        greedy_init, init_flows, init_prices, init_unsched,
-        costs, supply, capacity, arc_capacity,
-    )
     flows_p = np.zeros((E_pad, M_pad), dtype=np.int32)
     if init_flows is not None:
         flows_p[:E, :M] = init_flows
@@ -1079,7 +1180,15 @@ def solve_transport_selective(
     mask = np.zeros(M, dtype=bool)
     mask[part.ravel()] = True
     if init_flows is not None:
-        mask |= np.asarray(init_flows).sum(axis=0) > 0
+        # Mirror the kernel's warm clip: rows whose carried flow exceeds
+        # the (shrunken) supply are dropped wholesale at solve init, so
+        # their columns must not widen the selection — a stale frame
+        # from a full-population round would otherwise force the union
+        # to (nearly) the full width.
+        fl = np.asarray(init_flows)
+        fits = fl.sum(axis=1) <= supply
+        if fits.any():
+            mask |= fl[fits].sum(axis=0) > 0
     # Round the selection itself UP to a power-of-FOUR width (128, 512,
     # 2048, ...) by adding the globally cheapest unselected columns: the
     # union's size varies round to round, and every distinct reduced
@@ -1089,26 +1198,35 @@ def solve_transport_selective(
     target = 128
     while target < int(mask.sum()):
         target *= 4
-    if target * 4 >= M * 3:
-        return full()
-    if mask.sum() < target:
-        col_min = np.where(
-            (costs < INF_COST).any(axis=0), costs.min(axis=0), INF_COST
-        )
-        order = np.argsort(col_min, kind="stable")
-        extra = order[~mask[order]][: target - int(mask.sum())]
+    col_min = np.where(
+        (costs < INF_COST).any(axis=0), costs.min(axis=0), INF_COST
+    )
+    order = np.argsort(col_min, kind="stable")
+
+    def widen_to(t):
+        extra = order[~mask[order]][: t - int(mask.sum())]
         mask[extra] = True
-    sel = np.nonzero(mask)[0]
 
     # Contention pre-check: under broad contention (wave rounds — total
     # demand near the union's capacity) flow is forced beyond every
     # row's cheap columns, the certificate fails, and the reduced solve
     # is pure waste (measured ~46% of a wave band's iterations).  The
-    # reduction is for SPARSE rounds; require comfortable slack.
-    if int(supply.astype(np.int64).sum()) * 2 > int(
-        capacity.astype(np.int64)[sel].sum()
-    ):
+    # union must hold the supply with comfortable slack; rather than
+    # falling straight back to the full width, widen the selection a
+    # rung at a time (adding the globally cheapest columns — exactly
+    # the ones a capacity-squeezed optimum reaches for next).
+    need = 2 * int(supply.astype(np.int64).sum())
+
+    def capacity_of(t):
+        if mask.sum() < t:
+            widen_to(t)
+        return int(capacity.astype(np.int64)[mask].sum())
+
+    while target * 4 < M * 3 and capacity_of(target) < need:
+        target *= 4
+    if target * 4 >= M * 3:
         return full()
+    sel = np.nonzero(mask)[0]
 
     # The reduced solve runs at the FULL instance's scale so the 1/n
     # optimality bound certifies against the full node count

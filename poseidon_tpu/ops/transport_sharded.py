@@ -133,10 +133,14 @@ def solve_transport_sharded(
             raise ValueError("arc_capacity must be non-negative")
         arc_cap_p[:E, :M] = arc_capacity
     # Shared cold-start policy — keeps the sharded path's bit-identical-
-    # to-single-chip property.
-    init_flows, init_unsched = transport.maybe_greedy_start(
-        greedy_init, init_flows, init_prices, init_unsched,
-        costs, supply, capacity, arc_capacity,
+    # to-single-chip property (the mesh-rounded m_pad lands on the same
+    # quarter-octave bucket for mesh sizes dividing it, so the derived
+    # scale — and with it the greedy duals — match the single chip's).
+    (init_flows, init_unsched, init_prices,
+     eps_start) = transport.maybe_greedy_start(
+        greedy_init, init_flows, init_prices, init_unsched, eps_start,
+        costs, supply, capacity, arc_capacity, unsched_cost,
+        max_cost_hint, e_pad, m_pad, scale=scale,
     )
     flows_p = np.zeros((e_pad, m_pad), dtype=np.int32)
     if init_flows is not None:

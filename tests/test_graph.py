@@ -274,6 +274,58 @@ class TestRoundPlanner:
             sol.flows.sum(axis=1) + sol.unsched, supply
         )
 
+    def test_exhausted_solve_drops_warm_frame(self):
+        """A budget-exhausted band solve must not save its junk duals as
+        the next round's warm frame (and must evict any stale one)."""
+        from poseidon_tpu.costmodel import get_cost_model
+        from poseidon_tpu.graph.instance import RoundPlanner
+        from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+        from poseidon_tpu.utils.ids import generate_uuid
+
+        state = ClusterState()
+        for i in range(4):
+            state.node_added(
+                MachineInfo(
+                    uuid=generate_uuid(f"wf-m{i}"),
+                    cpu_capacity=8000, ram_capacity=1 << 24, task_slots=20,
+                )
+            )
+        for i in range(12):
+            state.task_submitted(
+                TaskInfo(uid=5000 + i, job_id="wf-j", cpu_request=300,
+                         ram_request=1 << 19)
+            )
+        planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+        _, m = planner.schedule_round()
+        assert m.converged and planner._warm_bands  # healthy frame saved
+
+        # Starve the budgets so the next (churned) round exhausts even
+        # the cold retry: every solve returns gap_bound=inf.
+        orig = planner._dispatch_solve
+
+        def starved(costs, supply, capacity, unsched_cost, prices=None,
+                    **kw):
+            kw["max_iter_total"] = 1
+            # Any feasible starting state (greedy cold start, carried
+            # warm flows) would exit clean with a finite gap; the empty
+            # start is what produces the budget-exhausted inf-gap state
+            # under test.
+            kw["greedy_init"] = False
+            kw.pop("eps_start", None)
+            kw.pop("init_flows", None)
+            kw.pop("init_unsched", None)
+            return orig(costs, supply, capacity, unsched_cost, None, **kw)
+
+        planner._dispatch_solve = starved
+        state.task_removed(5000)
+        state.task_submitted(
+            TaskInfo(uid=5000, job_id="wf-j", cpu_request=300,
+                     ram_request=1 << 19)
+        )
+        _, m2 = planner.schedule_round()
+        assert not m2.converged
+        assert not planner._warm_bands  # junk frame dropped, stale evicted
+
     def test_starved_greedy_cold_start_is_feasible_with_finite_gap(self):
         """With the greedy cold start, a starved budget still exits with a
         feasible state and a FINITE certified gap bound (the greedy

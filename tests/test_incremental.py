@@ -58,7 +58,11 @@ def test_quiet_round_fast_path():
                  cpu_request=100, ram_request=1 << 18)
     )
     deltas3, m3 = planner.schedule_round()
-    assert m3.iterations > 0 and m3.placed == 1
+    # device_calls, not iterations: the greedy+auction-dual cold start
+    # can solve a one-task instance in ZERO device iterations (already
+    # optimal at entry) — the dispatch count is what proves the solve
+    # re-armed.
+    assert m3.device_calls > 0 and m3.placed == 1
     # The re-solve may migrate toward a cheaper optimum; it must then
     # settle: the following round is quiet again.
     deltas4, m4 = planner.schedule_round()

@@ -310,21 +310,20 @@ class TestSelectiveSolve:
         target = 128
         while target < int(mask.sum()):
             target *= 4
-        if target * 4 >= M * 3:
-            return False
-        if mask.sum() < target:
-            col_min = np.where(
-                (costs < INF_COST).any(axis=0), costs.min(axis=0), INF_COST
-            )
-            order = np.argsort(col_min, kind="stable")
-            extra = order[~mask[order]][: target - int(mask.sum())]
-            mask[extra] = True
+        col_min = np.where(
+            (costs < INF_COST).any(axis=0), costs.min(axis=0), INF_COST
+        )
+        order = np.argsort(col_min, kind="stable")
         if capacity is not None:
-            if int(supply.astype(np.int64).sum()) * 2 > int(
-                capacity.astype(np.int64)[mask].sum()
-            ):
-                return False
-        return True
+            need = 2 * int(supply.astype(np.int64).sum())
+            while target * 4 < M * 3:
+                if mask.sum() < target:
+                    extra = order[~mask[order]][: target - int(mask.sum())]
+                    mask[extra] = True
+                if int(capacity.astype(np.int64)[mask].sum()) >= need:
+                    break
+                target *= 4
+        return target * 4 < M * 3
 
     @pytest.mark.parametrize("seed", range(6))
     def test_matches_oracle(self, seed):

@@ -63,6 +63,32 @@ def main() -> None:
         ts.append(time.perf_counter() - t0)
     print(f"dispatch p50: {p50(ts)*1e6:.0f} us  (min {min(ts)*1e6:.0f} us)")
 
+    # 1b. while-step overhead: a jitted loop of N trivial iterations.
+    # On TPU each lax.while_loop step pays a fixed sync/predicate cost;
+    # this measures it directly (drives the unroll-factor decisions).
+    from jax import lax
+
+    def loop(n):
+        def body(st):
+            x, i = st
+            return x + 1, i + 1
+
+        def cond(st):
+            return st[1] < n
+
+        return lax.while_loop(cond, body, (jnp.int32(0), jnp.int32(0)))[0]
+
+    jloop = jax.jit(loop)
+    jloop(jnp.int32(1)).block_until_ready()
+    for n in (1000, 10000):
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jloop(jnp.int32(n)).block_until_ready()
+            ts.append(time.perf_counter() - t0)
+        print(f"while_loop {n} steps p50: {p50(ts)*1e3:.1f} ms "
+              f"({p50(ts)/n*1e6:.2f} us/step)", flush=True)
+
     # 2. operand transfer for a band-solve-sized instance
     E, M = args.ecs, args.machines
     rng = np.random.default_rng(0)
