@@ -574,9 +574,12 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
 # full-width pushes each phase redistributes in ~100-190 iterations, so
 # FEWER meaningful phases win until the single-phase jump overloads the
 # refine.  (16^k measured ~1.4-1.7x worse than 256^k in round 3's
-# earlier sweep.)
+# earlier sweep.)  4 phases always reach eps=1: every ladder start —
+# cold eps0 <= 2^26, drift/dual eps <= ~2^29 — is below 4096^3, so the
+# k=3 entry is 1 and a 5th phase was a guaranteed no-op still paying
+# its refine and scan step.
 LADDER_FACTOR = 4096
-NUM_PHASES = 5
+NUM_PHASES = 4
 
 
 def derive_scale(costs, unsched_cost, max_cost_hint, num_ecs, num_machines):
