@@ -641,7 +641,16 @@ def _host_validate(costs, supply, capacity, unsched_cost, scale, eps_start,
     # incremental re-solve starts the ladder at eps_start (the scaled
     # magnitude of the cost drift since the last round).
     max_c = max(max_raw_q * scale, 1)
-    eps0 = max_c // 2 if eps_start is None else max(1, int(eps_start))
+    # Caller eps_start is clamped to the cold start: a larger value is
+    # pointless (cold covers it) and arithmetically unsafe (eps scales
+    # distances in the global update's int32 price arithmetic).  Any
+    # in-range value reaches rung 1 within NUM_PHASES (max_c/2 <= 2^26
+    # << 4096^3).  Internal producers (drift / dual gates) stay far
+    # below this bound on their own.
+    eps0 = (
+        max_c // 2 if eps_start is None
+        else max(1, min(int(eps_start), max_c // 2))
+    )
     eps_sched = np.asarray(
         [max(1, eps0 // LADDER_FACTOR**k) for k in range(NUM_PHASES)],
         dtype=np.int32
@@ -803,13 +812,15 @@ def maybe_greedy_start(greedy_init, init_flows, init_prices, init_unsched,
         supply=supply, capacity=capacity, unsched_cost=unsched_cost,
         scale=scale, arc_capacity=arc_capacity,
     )
-    # Under heavy contention the residual violation approaches the cold
-    # ladder's own start and the dual perturbation only adds noise
-    # (measured: 10k-machine cold iterations DOUBLED with unconditional
-    # duals).  Use them only when they skip at least one ladder rung —
-    # with a floor of one scale unit so narrow cost ranges (small
-    # max_raw_q) never lose near-exact starts to the rung arithmetic.
-    if eps_g > max(scale, max_raw_q * scale // 2 // LADDER_FACTOR):
+    # Gate: a dual start above half the cold ladder's eps0 would start
+    # the ladder at (or above) where cold starts anyway — pure noise.
+    # Below that the equilibrium duals measured strictly better or equal
+    # at every scale (10k churn -18% iterations, 10k wave1 659 -> 572,
+    # 1k cold 378 -> 334; the earlier "cold iterations DOUBLED" was the
+    # pre-alternation construction).  The one-scale-unit floor keeps
+    # narrow cost ranges (small max_raw_q) from losing near-exact
+    # starts to the arithmetic.
+    if eps_g > max(scale, max_raw_q * scale // 4):
         return init_flows, init_unsched, None, None
     return init_flows, init_unsched, init_prices, eps_g
 
