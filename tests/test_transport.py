@@ -443,6 +443,27 @@ class TestSelectiveSolve:
         assert sol.objective == expected
 
 
+def test_auction_dual_start_certifies_uncontested():
+    """On an uncontested instance (ample capacity, distinct cheap
+    columns per row) the greedy cold start plus its auction duals is
+    already 1-optimal: the solve must confirm in ZERO device iterations
+    with an exact certificate."""
+    from poseidon_tpu.ops.transport import solve_transport
+
+    E, M = 6, 120
+    costs = np.full((E, M), 3000, dtype=np.int32)
+    for e in range(E):
+        costs[e, e * 20 : e * 20 + 20] = 10 + e  # disjoint cheap tiers
+    supply = np.full(E, 10, dtype=np.int32)
+    cap = np.full(M, 4, dtype=np.int32)
+    unsched = np.full(E, 6000, dtype=np.int32)
+    sol = solve_transport(costs, supply, cap, unsched)
+    expected = oracle.transport_objective(costs, supply, cap, unsched)
+    assert sol.objective == expected
+    assert sol.gap_bound == 0.0
+    assert sol.iterations == 0, sol.iterations
+
+
 @pytest.mark.parametrize("seed", range(5))
 def test_greedy_flows_always_feasible(seed):
     """The cold-start initializer must respect supply, column capacity,
