@@ -56,35 +56,66 @@ import numpy as np
 
 LADDER = [(1_000, 10_000), (2_000, 20_000), (4_000, 40_000),
           (10_000, 100_000)]
-RUNG_TIMEOUT_S = 900
+RUNG_TIMEOUT_S = int(os.environ.get("POSEIDON_BENCH_RUNG_TIMEOUT", "1800"))
 PARITY_TIMEOUT_S = 600
+# Grace between SIGTERM and SIGKILL for a timed-out child: the child's
+# SIGTERM handler (install_graceful_term) exits after the in-flight
+# device op returns, so the grace must cover one worst-case device
+# program.  SIGKILL is the very last resort — killing a chip-holding
+# process mid-op wedges the tunnel for everyone.
+TERM_GRACE_S = int(os.environ.get("POSEIDON_BENCH_TERM_GRACE", "300"))
+# Pre-work allowance added to every child budget: a child may spend up to
+# the device-lock timeout waiting for another chip user plus the backend
+# probe before its measured work starts; charging that wait against the
+# rung/parity budget would SIGTERM a child that was merely queueing.
+PREWORK_S = (
+    0 if os.environ.get("POSEIDON_BENCH_NO_PROBE")
+    else int(float(os.environ.get("POSEIDON_DEVICE_LOCK_TIMEOUT", "600")))
+    + 300
+)
 
 
 def _ensure_live_backend() -> None:
     """Probe the accelerator in a subprocess; fall back to CPU if dead.
 
     The TPU tunnel can wedge (worker crash leaves every op hanging
-    forever).  A 120s subprocess probe detects that without hanging this
+    forever).  A subprocess probe detects that without hanging this
     process; the fallback re-execs with the accelerator plugin stripped
     so the benchmark still reports a number (tagged via ``backend``).
+    The host-wide device lock is taken FIRST — concurrent backend init
+    across processes is itself a wedge trigger — and held for this
+    process's lifetime, covering the probe child and the rung itself.
     """
     if os.environ.get("POSEIDON_BENCH_NO_PROBE"):
         return
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax,jax.numpy as jnp;"
-             "print(float((jnp.ones((64,64))@jnp.ones((64,64))).sum()))"],
-            capture_output=True, text=True, timeout=150,
-        )
-        # ones(64,64) @ ones(64,64) sums to 64**3 = 262144.
-        ok = probe.returncode == 0 and "262144" in probe.stdout
-    except subprocess.TimeoutExpired:
+    from poseidon_tpu.utils.envutil import (
+        clean_cpu_env,
+        serialize_device_access,
+    )
+
+    locked = serialize_device_access(
+        timeout=float(os.environ.get("POSEIDON_DEVICE_LOCK_TIMEOUT", "600"))
+    )
+    if locked:
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax,jax.numpy as jnp;"
+                 "print(float((jnp.ones((64,64))@jnp.ones((64,64))).sum()))"],
+                capture_output=True, text=True, timeout=300,
+            )
+            # ones(64,64) @ ones(64,64) sums to 64**3 = 262144.
+            ok = probe.returncode == 0 and "262144" in probe.stdout
+        except subprocess.TimeoutExpired:
+            ok = False
+    else:
+        # Another process owns the chip and is not yielding: CPU fallback
+        # beats racing it (the race wedges the tunnel for both).
+        print("# device lock busy; not contending for the accelerator",
+              file=sys.stderr)
         ok = False
     if ok:
         return
-    from poseidon_tpu.utils.envutil import clean_cpu_env
-
     env = clean_cpu_env(os.path.dirname(os.path.abspath(__file__)))
     env["POSEIDON_BENCH_NO_PROBE"] = "1"
     print("# accelerator unreachable; falling back to CPU", file=sys.stderr)
@@ -295,19 +326,38 @@ def run_parity() -> dict:
 
 
 def _child(mode: str, argv: list, timeout: int) -> dict:
-    """Run one rung/parity in a subprocess; never raises."""
+    """Run one rung/parity in a subprocess; never raises.
+
+    Timeout discipline: SIGTERM first (the child's handler exits after
+    the in-flight device op completes — never mid-op), then a long grace,
+    then SIGKILL only for a child already hung inside a wedged tunnel.
+    """
     cmd = [sys.executable, os.path.abspath(__file__), "--child", mode] + argv
     try:
-        r = subprocess.run(cmd, capture_output=True, text=True,
-                           timeout=timeout)
-        sys.stderr.write(r.stderr)
-        for line in reversed(r.stdout.splitlines()):
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+        timed_out = False
+        try:
+            out, err = proc.communicate(timeout=timeout + PREWORK_S)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            proc.terminate()
+            try:
+                out, err = proc.communicate(timeout=TERM_GRACE_S)
+            except subprocess.TimeoutExpired:
+                print(f"# child {mode} ignored SIGTERM for {TERM_GRACE_S}s "
+                      "(wedged tunnel?); escalating to SIGKILL",
+                      file=sys.stderr)
+                proc.kill()
+                out, err = proc.communicate()
+        sys.stderr.write(err)
+        if timed_out:
+            return {"ok": False, "error": f"timeout after {timeout}s"}
+        for line in reversed(out.splitlines()):
             if line.startswith("{"):
                 return json.loads(line)
         return {"ok": False,
-                "error": f"rc={r.returncode}, no JSON in child output"}
-    except subprocess.TimeoutExpired:
-        return {"ok": False, "error": f"timeout after {timeout}s"}
+                "error": f"rc={proc.returncode}, no JSON in child output"}
     except Exception as e:  # noqa: BLE001 - the artifact must always emit
         return {"ok": False, "error": repr(e)}
 
@@ -328,9 +378,13 @@ def main(argv=None) -> int:
         _ensure_live_backend()
         # Persistent compile cache: rung/trace children each start a fresh
         # process; without it every child repeats the full compile storm.
-        from poseidon_tpu.utils.envutil import enable_compilation_cache
+        from poseidon_tpu.utils.envutil import (
+            enable_compilation_cache,
+            install_graceful_term,
+        )
 
         enable_compilation_cache()
+        install_graceful_term()
     if args.child == "rung":
         print(json.dumps(run_rung(args.machines, args.tasks, args.ecs,
                                   args.rounds, args.verbose)))

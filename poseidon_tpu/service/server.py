@@ -230,12 +230,26 @@ def main(argv=None) -> None:
         level=logging.INFO,
         format="%(asctime)s %(levelname).1s %(name)s] %(message)s",
     )
-    from poseidon_tpu.utils.envutil import enable_compilation_cache
+    from poseidon_tpu.utils.envutil import (
+        DEVICE_LOCK_PATH,
+        enable_compilation_cache,
+        serialize_device_access,
+    )
 
     # Service restarts must not repeat the compile storm (the reference's
     # restart posture is rebuild-from-watch, SURVEY.md section 5 — ours
     # additionally recovers the compiled kernels from the on-disk cache).
     enable_compilation_cache()
+    # One accelerator-touching process at a time, host-wide: concurrent
+    # backend init (or killing a chip holder mid-op) wedges the exclusive
+    # accelerator's tunnel for every process on the machine.  Block until
+    # held: a scheduler racing another chip user helps no one.
+    if not serialize_device_access(timeout=600):
+        log.warning(
+            "device lock %s busy after 600s; waiting indefinitely",
+            DEVICE_LOCK_PATH,
+        )
+        serialize_device_access(timeout=None)
     cfg = load_config(FirmamentTPUConfig, argv=argv)
     server = FirmamentTPUServer(config=cfg).start()
     stop = threading.Event()

@@ -13,6 +13,7 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import time
 from typing import Optional
 
 
@@ -94,6 +95,97 @@ def enable_compilation_cache(path: Optional[str] = None) -> None:
             "jax_persistent_cache_min_compile_time_secs",
             float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]),
         )
+
+
+# ---------------------------------------------------------------- device lock
+#
+# The accelerator is a single exclusive chip behind a stateful tunnel that
+# wedges GLOBALLY — for hours — when (a) a process holding the chip is
+# killed mid-op, or (b) two processes race backend initialization (the
+# second blocks forever inside plugin client construction).  Both are
+# process-coordination failures, so the cure is cross-process: one
+# advisory flock serializes every accelerator-touching process on the
+# host (bench children, the gRPC service, the driver entry points,
+# profiling tools).  The fd is held for the life of the process and the
+# OS drops the lock on ANY exit — including SIGKILL — so a dead holder
+# can never leave the lock stuck.
+
+DEVICE_LOCK_PATH = os.environ.get(
+    "POSEIDON_DEVICE_LOCK", "/tmp/poseidon_tpu_device.lock"
+)
+_device_lock_fd: Optional[int] = None
+
+
+def _may_touch_accelerator() -> bool:
+    """True when this process's jax could initialize the accelerator
+    plugin (the only case the cross-process lock exists for)."""
+    return os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu"
+
+
+def serialize_device_access(timeout: Optional[float] = None) -> bool:
+    """Take the host-wide accelerator lock before backend init.
+
+    Call this BEFORE the first jax device use in any process that may
+    touch the accelerator.  Blocks until the lock is held (or ``timeout``
+    seconds elapsed — then returns False and the caller should fall back
+    to CPU rather than race).  No-ops (returns True) on CPU-pinned
+    processes and when the lock is already held by this process.
+    Reentrant per process; released automatically on process exit.
+    """
+    global _device_lock_fd
+    if not _may_touch_accelerator():
+        return True
+    if _device_lock_fd is not None:
+        return True
+    try:
+        import fcntl
+    except ImportError:  # non-POSIX: nothing to serialize with
+        return True
+    try:
+        fd = os.open(DEVICE_LOCK_PATH, os.O_CREAT | os.O_RDWR, 0o666)
+    except OSError:
+        # Unopenable lock file (another user's umask-narrowed file on a
+        # shared host, read-only /tmp): report "could not serialize" so
+        # the caller takes its CPU fallback instead of crashing.
+        return False
+    deadline = None if timeout is None else time.monotonic() + timeout
+    while True:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            break
+        except OSError:
+            if deadline is not None and time.monotonic() >= deadline:
+                os.close(fd)
+                return False
+            time.sleep(1.0)
+    try:
+        os.ftruncate(fd, 0)
+        os.write(fd, f"pid={os.getpid()}\n".encode())
+    except OSError:
+        pass  # lock content is diagnostic only
+    _device_lock_fd = fd
+    return True
+
+
+def install_graceful_term() -> None:
+    """Make SIGTERM exit at the next Python bytecode boundary.
+
+    A blocking device op runs inside C++ where Python signal handlers
+    cannot fire, so a handler that raises SystemExit runs only AFTER the
+    in-flight op returns — terminating a chip-holding child this way
+    never kills it mid-op (the tunnel-wedge trigger).  A child that never
+    reaches the handler is already hung inside a wedged tunnel, where
+    escalation loses nothing.
+    """
+    import signal
+
+    def _term(signum, frame):
+        raise SystemExit(143)
+
+    try:
+        signal.signal(signal.SIGTERM, _term)
+    except ValueError:
+        pass  # non-main thread: caller manages its own lifecycle
 
 
 def backend_initialized() -> bool:

@@ -84,3 +84,88 @@ def test_kube_version_parsing():
     for bad in ("latest", "1", "1.x"):
         with pytest.raises(ValueError):
             PoseidonConfig(kube_version=bad).kube_version_tuple()
+
+
+# ---------------------------------------------------------------- device lock
+
+
+def test_serialize_device_access_noop_on_cpu(monkeypatch):
+    # CPU-pinned processes (every test, per conftest) never contend for
+    # the accelerator, so the lock is a no-op success.
+    from poseidon_tpu.utils import envutil
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(envutil, "_device_lock_fd", None)
+    assert envutil.serialize_device_access(timeout=0.1)
+    assert envutil._device_lock_fd is None  # no fd opened
+
+
+def test_serialize_device_access_excludes_second_process(
+    monkeypatch, tmp_path
+):
+    # Holder in a subprocess -> this process's acquire times out (False);
+    # after the holder exits, acquire succeeds and is reentrant.
+    import subprocess
+    import sys
+    import textwrap
+
+    from poseidon_tpu.utils import envutil
+
+    lock = tmp_path / "device.lock"
+    monkeypatch.setenv("JAX_PLATFORMS", "")  # accelerator-capable
+    monkeypatch.setattr(envutil, "DEVICE_LOCK_PATH", str(lock))
+    monkeypatch.setattr(envutil, "_device_lock_fd", None)
+
+    holder = subprocess.Popen(
+        [sys.executable, "-c", textwrap.dedent(f"""
+            import fcntl, os, sys, time
+            fd = os.open({str(lock)!r}, os.O_CREAT | os.O_RDWR)
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            print("held", flush=True)
+            sys.stdin.read()  # hold until stdin closes
+        """)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        assert holder.stdout.readline().strip() == "held"
+        assert not envutil.serialize_device_access(timeout=0.1)
+        assert envutil._device_lock_fd is None
+    finally:
+        holder.stdin.close()
+        holder.wait(timeout=30)
+    assert envutil.serialize_device_access(timeout=5.0)
+    assert envutil._device_lock_fd is not None
+    assert envutil.serialize_device_access(timeout=0.0)  # reentrant
+    # Cleanup: release for later tests in this process.
+    import os as _os
+
+    _os.close(envutil._device_lock_fd)
+    monkeypatch.setattr(envutil, "_device_lock_fd", None)
+
+
+def test_install_graceful_term_exits_at_bytecode_boundary():
+    # SIGTERM must terminate the child cleanly (exit 143) from its Python
+    # loop — the semantics that let the bench parent stop a chip-holding
+    # child without killing it mid-device-op.
+    import signal
+    import subprocess
+    import sys
+    import textwrap
+
+    child = subprocess.Popen(
+        [sys.executable, "-c", textwrap.dedent("""
+            from poseidon_tpu.utils.envutil import install_graceful_term
+            install_graceful_term()
+            print("ready", flush=True)
+            while True:
+                pass
+        """)],
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        assert child.stdout.readline().strip() == "ready"
+        child.send_signal(signal.SIGTERM)
+        assert child.wait(timeout=30) == 143
+    finally:
+        if child.poll() is None:
+            child.kill()

@@ -38,11 +38,19 @@ def main() -> None:
     ap.add_argument("--reps", type=int, default=20)
     args = ap.parse_args()
 
-    # Never-hang posture: a wedged tunnel blocks the first backend touch
-    # forever, so probe in a disposable subprocess first (envutil pattern).
-    from poseidon_tpu.utils.envutil import probe_device_count
+    # Never-hang posture: take the host-wide device lock (concurrent
+    # backend init wedges the tunnel), then probe in a disposable
+    # subprocess before committing this process to the first jax touch.
+    from poseidon_tpu.utils.envutil import (
+        probe_device_count,
+        serialize_device_access,
+    )
 
-    if probe_device_count(timeout=150.0) < 0:
+    if not serialize_device_access(timeout=600):
+        print("device lock busy; not contending for the accelerator",
+              flush=True)
+        raise SystemExit(2)
+    if probe_device_count(timeout=300.0) < 0:
         print("backend unreachable (wedged tunnel?); aborting", flush=True)
         raise SystemExit(2)
 
