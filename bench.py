@@ -33,14 +33,15 @@ Prints ONE JSON line PER COMPLETED STAGE (each a superset of the
 previous; consumers take the LAST line — this way a kill at any point
 still leaves a valid, maximal artifact on stdout)::
 
-  {"metric": "schedule_round_s", "value": <churn p50 s>, "unit": "s",
+  {"metric": "schedule_round_s", "value": <wave p50 s>, "unit": "s",
    "vs_baseline": <1.0/value>, "machines": ..., "tasks": ...,
    "cold_s": ..., "wave_p50_s": ..., "churn_p50_s": ...,
    "parity_ok": true, "trace": {...config-5 replay...},
    "ladder": [...per-rung results/errors...]}
 
-``value`` is the churn p50 at the largest completed rung — the
-steady-state number a production cluster actually pays every round.
+``value`` is the fresh-population WAVE p50 at the largest completed rung
+— the north-star config's own number (100k pods pending at once);
+``churn_p50_s`` reports the steady-state latency alongside it.
 """
 
 from __future__ import annotations
@@ -93,9 +94,7 @@ def _ensure_live_backend() -> None:
         serialize_device_access,
     )
 
-    locked = serialize_device_access(
-        timeout=float(os.environ.get("POSEIDON_DEVICE_LOCK_TIMEOUT", "600"))
-    )
+    locked = serialize_device_access()  # $POSEIDON_DEVICE_LOCK_TIMEOUT
     if locked:
         try:
             probe = subprocess.run(
@@ -189,10 +188,22 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
     state = build_cluster(machines, tasks, ecs, seed=0)
     planner = RoundPlanner(state, get_cost_model("cpu_mem"))
 
+    # Partial-progress lines: each completed stage prints a JSON line
+    # (ok=False + "partial" until the rung finishes), so a parent-side
+    # timeout mid-rung still salvages every number measured so far —
+    # on a slow/unproven backend the partial cold/wave figures are the
+    # artifact that matters.
+    partial = {
+        "machines": machines, "tasks": tasks, "backend": backend,
+        "cache_warm": cache_warm, "ok": False,
+    }
+
     t0 = time.perf_counter()
     _, metrics = planner.schedule_round()
     cold_s = time.perf_counter() - t0
     converged = metrics.converged
+    partial.update(cold_s=round(cold_s, 4), partial="after cold round")
+    print(json.dumps(partial), flush=True)
     if verbose:
         print(f"# [{machines}] cold: {cold_s:.3f}s placed={metrics.placed} "
               f"unsched={metrics.unscheduled}", file=sys.stderr)
@@ -231,6 +242,12 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
                   f"iters={metrics.iterations} bf={metrics.bf_sweeps} "
                   f"calls={metrics.device_calls}",
                   file=sys.stderr)
+        partial.update(
+            precompile_s=round(precompile_s, 4),
+            wave_p50_s=round(float(np.percentile(wave_lat, 50)), 4),
+            partial=f"after wave {r + 1}/{rounds}",
+        )
+        print(json.dumps(partial), flush=True)
 
     # Steady-state churn: replace 1% of tasks per round.
     rng = np.random.default_rng(12345)
@@ -282,7 +299,14 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
 def run_trace(machines: int, tasks: int, rounds: int) -> dict:
     """BASELINE config 5: Google-trace-shaped replay with incremental
     delta re-solve (poseidon_tpu.replay) — churning jobs/completions
-    between rounds rather than synthetic drain/resubmit."""
+    between rounds rather than synthetic drain/resubmit.
+
+    Two stages: the steady-state replay at full scale, then a PRESSURE
+    replay (smaller fleet, 10% of machines removed mid-trace, continuous
+    rebalancing) that forces the PREEMPT/MIGRATE delta paths — the two
+    delta types a pure submit/complete replay never emits (round-3
+    review: ``preempted: 0, migrated: 0`` left them untested at scale).
+    """
     import jax
 
     from poseidon_tpu.replay.driver import ReplayDriver
@@ -294,8 +318,25 @@ def run_trace(machines: int, tasks: int, rounds: int) -> dict:
     driver = ReplayDriver(events, round_interval_s=10.0)
     report = driver.run(max_rounds=rounds)
     out = report.summary()
+    # Partial artifact before the pressure stage: a timeout there must
+    # not discard the completed steady-state replay.
     out["backend"] = jax.devices()[0].platform
     out["ok"] = True
+    out["pressure"] = {"ok": False, "error": "not run"}
+    print(json.dumps(out), flush=True)
+
+    p_machines = min(max(machines // 4, 200), 2500)
+    p_rounds = min(rounds, 20)
+    p_events = synthesize_trace(
+        p_machines, max(p_machines * 10 // 8, 1),
+        horizon_s=p_rounds * 10.0, seed=4, remove_frac=0.10,
+    )
+    p_driver = ReplayDriver(
+        p_events, round_interval_s=10.0, reschedule_running=True,
+    )
+    p_summary = p_driver.run(max_rounds=p_rounds).summary()
+    p_summary["ok"] = True
+    out["pressure"] = p_summary
     return out
 
 
@@ -351,11 +392,28 @@ def _child(mode: str, argv: list, timeout: int) -> dict:
                 proc.kill()
                 out, err = proc.communicate()
         sys.stderr.write(err)
-        if timed_out:
-            return {"ok": False, "error": f"timeout after {timeout}s"}
+        # Children print a JSON line per completed stage, so even a
+        # timed-out child usually leaves partial measurements on stdout —
+        # salvage the last one instead of discarding the whole stage.
+        last = None
         for line in reversed(out.splitlines()):
             if line.startswith("{"):
-                return json.loads(line)
+                try:
+                    last = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # a line truncated by the kill
+                break
+        if timed_out:
+            if last is None:
+                return {"ok": False, "error": f"timeout after {timeout}s"}
+            # Children mark their own partiality ("partial"/ok fields):
+            # a rung's stage lines carry ok=False until the rung
+            # finishes, while the trace child's pre-pressure line is a
+            # complete, valid main-replay result — don't overwrite it.
+            last["timed_out"] = f"timeout after {timeout}s"
+            return last
+        if last is not None:
+            return last
         return {"ok": False,
                 "error": f"rc={proc.returncode}, no JSON in child output"}
     except Exception as e:  # noqa: BLE001 - the artifact must always emit
@@ -429,14 +487,17 @@ def main(argv=None) -> int:
             out.update({"value": None, "vs_baseline": 0.0,
                         "error": "no ladder rung completed"})
         else:
-            # Headline: steady-state churn p50 at the largest completed
-            # rung — the latency a production cluster pays every round
-            # (the bit-identical warm wave would flatter; cold would
-            # double-count one-time compiles).  An unconverged rung posts
+            # Headline: the NORTH-STAR config — a full pending wave at the
+            # largest completed rung (BASELINE.md: "10k nodes / 100k
+            # pending pods round < 1 s").  Steady-state churn p50 is
+            # reported alongside (the latency a production cluster pays
+            # every round) but does not set the score: round-3 review
+            # called scoring churn while the target sentence is the wave
+            # a 9x flattering of the headline.  An unconverged rung posts
             # no vs_baseline: budget-exhausted solves return fast but
             # commit uncertified placements, and claiming a win on them
             # would be dishonest.
-            value = best["churn_p50_s"]
+            value = best["wave_p50_s"]
             honest = bool(best.get("converged"))
             out.update({
                 "value": value,

@@ -617,8 +617,8 @@ class ClusterState:
         machines' resources as reservations (``cpu_used``/``ram_used``/
         ``net_rx_used``/``slots``).  ``include_running=True`` re-enters
         the whole workload for global re-optimization (the preemption /
-        rebalancing mode); reservations are then zero and the planner's
-        joint-capacity cuts take over.
+        rebalancing mode); reservations are then zero and the banded
+        ladder re-prices the whole workload from free capacity.
 
         Returns a ``RoundView`` (defined in costmodel.base's vocabulary):
         EC/machine structure-of-arrays tables plus per-EC member arrays

@@ -77,10 +77,20 @@ class ReplayDriver:
         round_interval_s: float = 10.0,
         gang_jobs: bool = False,
         precompile: bool = True,
+        reschedule_running: bool = False,
     ) -> None:
         self.events = sorted(events, key=lambda e: (e.time, e.kind))
         self.state = ClusterState()
-        self.planner = RoundPlanner(self.state, get_cost_model(cost_model))
+        # reschedule_running=True is the continuous-rebalancing replay:
+        # the whole workload re-enters every round, so capacity pressure
+        # (machine_remove events, load growth) surfaces as PREEMPT /
+        # MIGRATE deltas from the solver — the two delta types the
+        # reference client treats as first-class (poseidon.go:52-63) and
+        # a steady-state replay never exercises.
+        self.planner = RoundPlanner(
+            self.state, get_cost_model(cost_model),
+            reschedule_running=reschedule_running,
+        )
         self.round_interval_s = round_interval_s
         self.gang_jobs = gang_jobs
         # Replay churns the pending EC subset every round, walking the
@@ -89,9 +99,16 @@ class ReplayDriver:
         # compile — on a TPU that is tens of seconds per shape and
         # dwarfs the replay itself (the round-3 trace-stage timeout).
         self.precompile = precompile
-        # (end_time, job_id, task_uid) min-heap of running tasks.
+        # (end_time, task_uid) min-heap of running tasks.  Entries go
+        # stale when a task is evicted (machine_remove) or preempted and
+        # later re-placed with a NEW deadline: _deadline maps uid -> the
+        # one currently-valid end time, and _complete_due drops any heap
+        # entry that disagrees (completing an evicted task at its
+        # original end time would silently drain the pending backlog the
+        # pressure replay exists to create).
         self._ending: list = []
         self._durations: dict = {}
+        self._deadline: dict = {}
 
     def _apply_event(self, ev: TraceEvent) -> int:
         if ev.kind == "machine_add":
@@ -104,6 +121,12 @@ class ReplayDriver:
                     trace_machine_id=mid,
                 )
             )
+            return 0
+        if ev.kind == "machine_remove":
+            (mid,) = ev.payload
+            # Same id derivation as machine_add; running tasks are
+            # evicted back to runnable (nodewatcher NodeRemoved path).
+            self.state.node_removed(generate_uuid(f"trace-m{mid}"))
             return 0
         if ev.kind == "job_submit":
             job, n, cpu, ram, duration = ev.payload
@@ -124,10 +147,17 @@ class ReplayDriver:
     def _complete_due(self, now: float) -> int:
         done = 0
         while self._ending and self._ending[0][0] <= now:
-            _, uid = heapq.heappop(self._ending)
+            end, uid = heapq.heappop(self._ending)
             task = self.state.tasks.get(uid)
             if task is None:
                 continue
+            # Stale entry (task was evicted/preempted since this deadline
+            # was set) or task is not on a machine right now: it has not
+            # actually run its duration — skip; a fresh entry was / will
+            # be pushed when it is re-placed.
+            if self._deadline.get(uid) != end or task.scheduled_to is None:
+                continue
+            self._deadline.pop(uid, None)
             self.state.task_completed(uid)
             self.state.task_removed(uid)
             done += 1
@@ -167,14 +197,19 @@ class ReplayDriver:
             report.total_objective += metrics.objective
             report.converged = report.converged and metrics.converged
 
-            # Newly placed tasks start their duration clock.
+            # Newly placed tasks (re)start their duration clock; a
+            # preempted task's standing deadline is invalidated (it will
+            # get a fresh one when re-placed).  MIGRATEd tasks keep
+            # running — their deadline stands.
             for d in deltas:
                 if d.type == 1:  # PLACE
                     dur = self._durations.get(d.task_id)
                     if dur is not None:
-                        heapq.heappush(
-                            self._ending, (horizon + dur, d.task_id)
-                        )
+                        end = horizon + dur
+                        self._deadline[d.task_id] = end
+                        heapq.heappush(self._ending, (end, d.task_id))
+                elif d.type == 2:  # PREEMPT
+                    self._deadline.pop(d.task_id, None)
             now = horizon
             if max_rounds is not None and report.rounds >= max_rounds:
                 break

@@ -19,8 +19,9 @@ import numpy as np
 @dataclass(frozen=True)
 class TraceEvent:
     time: float
-    kind: str          # "machine_add" | "job_submit" | "task_end"
-    # machine_add: (machine_id, cpu_millicores, ram_kb)
+    kind: str  # "machine_add" | "machine_remove" | "job_submit" | "task_end"
+    # machine_add:    (machine_id, cpu_millicores, ram_kb)
+    # machine_remove: (machine_id,)
     # job_submit:  (job_id, num_tasks, cpu_millicores, ram_kb, duration_s)
     # task_end:    (job_id, task_index)
     payload: Tuple
@@ -43,9 +44,18 @@ def synthesize_trace(
     seed: int = 0,
     mean_tasks_per_job: float = 8.0,
     max_tasks_per_job: int = 512,
+    remove_frac: float = 0.0,
 ) -> List[TraceEvent]:
     """Machines join at t<0 (initial fleet); jobs arrive Poisson over the
-    horizon with Zipf-ish task counts and lognormal durations."""
+    horizon with Zipf-ish task counts and lognormal durations.
+
+    ``remove_frac`` > 0 injects capacity pressure: that fraction of the
+    fleet is REMOVED at random times in the middle half of the horizon
+    (the Google trace's machine-churn events; resource_desc.proto's
+    trace_machine_id exists for exactly this replay path).  Tasks running
+    there are evicted and re-placed; under a rebalancing planner
+    (reschedule_running) the shrunken capacity also forces PREEMPT /
+    MIGRATE deltas on the survivors."""
     rng = np.random.default_rng(seed)
     events: List[TraceEvent] = []
 
@@ -80,5 +90,14 @@ def synthesize_trace(
                  float(durations[j])),
             )
         )
+
+    if remove_frac > 0.0:
+        n_remove = int(num_machines * remove_frac)
+        victims = rng.choice(num_machines, size=n_remove, replace=False)
+        times = rng.uniform(0.25 * horizon_s, 0.75 * horizon_s,
+                            size=n_remove)
+        for mid, t in zip(victims.tolist(), times.tolist()):
+            events.append(TraceEvent(float(t), "machine_remove", (mid,)))
+
     events.sort(key=lambda e: (e.time, e.kind))
     return events

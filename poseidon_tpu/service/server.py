@@ -54,7 +54,6 @@ class FirmamentServicer:
             pod_affinity=self.config.pod_affinity,
             solver_devices=self.config.solver_devices,
             flow_solver=self.config.flow_solver,
-            solve_mode=self.config.solve_mode,
         )
         # Schedule() rounds are serialized: the planner's warm-start state
         # is single-writer (the reference client also calls Schedule from
@@ -243,11 +242,12 @@ def main(argv=None) -> None:
     # One accelerator-touching process at a time, host-wide: concurrent
     # backend init (or killing a chip holder mid-op) wedges the exclusive
     # accelerator's tunnel for every process on the machine.  Block until
-    # held: a scheduler racing another chip user helps no one.
-    if not serialize_device_access(timeout=600):
+    # held: a scheduler racing another chip user helps no one.  (False
+    # strictly means busy — envutil falls back to a per-uid lock when the
+    # shared file is unopenable.)
+    if not serialize_device_access():
         log.warning(
-            "device lock %s busy after 600s; waiting indefinitely",
-            DEVICE_LOCK_PATH,
+            "device lock %s busy; waiting indefinitely", DEVICE_LOCK_PATH
         )
         serialize_device_access(timeout=None)
     cfg = load_config(FirmamentTPUConfig, argv=argv)

@@ -56,12 +56,6 @@ class FirmamentTPUConfig:
     # the TPU cost-scaling push-relabel kernel; "ssp" the host
     # successive-shortest-path verification solver (exact, slow).
     flow_solver: str = "auction"
-    # Round decomposition: "banded" (size-band ladder, capacity-safe by
-    # construction, one solve per band; default — measured better under
-    # broad contention) or "cuts" (one joint solve with capacity-cut
-    # repair passes; pays off only on low-contention instances, banded
-    # fallback otherwise).
-    solve_mode: str = "banded"
     # Precompile ceilings: with precompile=True the first Schedule()
     # compiles the solver's (E_bucket, M_bucket) shape ladder up to these
     # bounds so churn rounds never pay first-compile latency.

@@ -122,17 +122,32 @@ def _may_touch_accelerator() -> bool:
     return os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu"
 
 
-def serialize_device_access(timeout: Optional[float] = None) -> bool:
+# Sentinel: "use the operator knob POSEIDON_DEVICE_LOCK_TIMEOUT (600s
+# default)" — so every call site honors the same env var without each
+# re-reading it.
+_ENV_TIMEOUT = object()
+
+
+def serialize_device_access(timeout=_ENV_TIMEOUT) -> bool:
     """Take the host-wide accelerator lock before backend init.
 
     Call this BEFORE the first jax device use in any process that may
     touch the accelerator.  Blocks until the lock is held (or ``timeout``
-    seconds elapsed — then returns False and the caller should fall back
-    to CPU rather than race).  No-ops (returns True) on CPU-pinned
+    seconds elapsed — then returns False, meaning BUSY: another process
+    holds the chip, and the caller should fall back to CPU rather than
+    race).  ``timeout`` defaults to $POSEIDON_DEVICE_LOCK_TIMEOUT (600);
+    pass None to wait forever.  No-ops (returns True) on CPU-pinned
     processes and when the lock is already held by this process.
     Reentrant per process; released automatically on process exit.
+
+    An UNOPENABLE shared lock file (another user's umask-narrowed file on
+    a multi-user host) falls back to a per-uid lock path: that still
+    serializes everything this uid runs — the overwhelmingly common
+    deployment — instead of either crashing or silently giving up.
     """
     global _device_lock_fd
+    if timeout is _ENV_TIMEOUT:
+        timeout = float(os.environ.get("POSEIDON_DEVICE_LOCK_TIMEOUT", "600"))
     if not _may_touch_accelerator():
         return True
     if _device_lock_fd is not None:
@@ -144,10 +159,16 @@ def serialize_device_access(timeout: Optional[float] = None) -> bool:
     try:
         fd = os.open(DEVICE_LOCK_PATH, os.O_CREAT | os.O_RDWR, 0o666)
     except OSError:
-        # Unopenable lock file (another user's umask-narrowed file on a
-        # shared host, read-only /tmp): report "could not serialize" so
-        # the caller takes its CPU fallback instead of crashing.
-        return False
+        try:
+            fd = os.open(
+                f"{DEVICE_LOCK_PATH}.{os.getuid()}",
+                os.O_CREAT | os.O_RDWR, 0o600,
+            )
+        except OSError:
+            # Even the per-uid path is unopenable (read-only /tmp):
+            # nothing to serialize with — proceeding beats deadlocking
+            # every caller forever.
+            return True
     deadline = None if timeout is None else time.monotonic() + timeout
     while True:
         try:

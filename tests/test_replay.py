@@ -33,3 +33,33 @@ def test_replay_gang_mode():
     # Gang atomicity holds per round by construction; the replay must
     # still make progress.
     assert report.placed > 0
+
+
+def test_trace_machine_remove_events():
+    events = synthesize_trace(40, 30, horizon_s=600.0, seed=5,
+                              remove_frac=0.25)
+    kinds = [e.kind for e in events]
+    assert kinds.count("machine_remove") == 10
+    # Removals land in the middle half of the horizon, after the fleet
+    # joins — pressure on a loaded cluster, not a cold one.
+    times = [e.time for e in events if e.kind == "machine_remove"]
+    assert all(150.0 <= t <= 450.0 for t in times)
+
+
+def test_pressure_replay_exercises_preempt_and_migrate():
+    """Capacity pressure (machine removals) under continuous rebalancing
+    must surface the PREEMPT/MIGRATE delta paths — the reference client
+    treats both as first-class (poseidon.go:52-63), and a pure
+    submit/complete replay never emits either."""
+    events = synthesize_trace(24, 60, horizon_s=600.0, seed=6,
+                              remove_frac=0.25)
+    driver = ReplayDriver(events, round_interval_s=30.0,
+                          reschedule_running=True)
+    report = driver.run(max_rounds=20)
+    assert report.placed > 0
+    assert report.preempted + report.migrated > 0, (
+        report.preempted, report.migrated
+    )
+    # Pressure rounds must stay certified: uncertified placements would
+    # make the delta counts meaningless.
+    assert report.converged

@@ -46,7 +46,7 @@ def main() -> None:
         serialize_device_access,
     )
 
-    if not serialize_device_access(timeout=600):
+    if not serialize_device_access():  # $POSEIDON_DEVICE_LOCK_TIMEOUT
         print("device lock busy; not contending for the accelerator",
               flush=True)
         raise SystemExit(2)
