@@ -413,6 +413,14 @@ def _child(mode: str, argv: list, timeout: int) -> dict:
             last["timed_out"] = f"timeout after {timeout}s"
             return last
         if last is not None:
+            if proc.returncode != 0:
+                # Crashed after printing partial lines: keep the numbers
+                # but carry the failure diagnostic the artifact needs.
+                last["ok"] = False
+                last.setdefault(
+                    "error", f"child exited rc={proc.returncode} "
+                    "after partial results"
+                )
             return last
         return {"ok": False,
                 "error": f"rc={proc.returncode}, no JSON in child output"}

@@ -44,6 +44,7 @@ solvers Firmament shells out to (reference deploy/firmament-deployment.yaml:29-3
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
 from typing import Optional
 
@@ -165,6 +166,12 @@ def _relabel_to(maxcand, has_adm, excess, p, eps):
 
 
 _DINF = 1 << 24  # "unreached" marker for global-update distances
+
+# Main-loop iterations per lax.while_loop step (see _pr_phase).  4 matches
+# the default global-update cadence so each group carries exactly one
+# global-update candidate slot.  Env-overridable for per-backend tuning
+# (read once at import: the value is baked into traced programs).
+ITER_UNROLL = int(os.environ.get("POSEIDON_ITER_UNROLL", "4"))
 
 
 def _global_update(F, Ffb, Fmt, pe, pm, pt, exc_e, exc_m, exc_t,
@@ -340,9 +347,22 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
             & active
         )
 
-    def body(st):
+    def iterate(st):
         F, Ffb, Fmt, exc, pe, pm, pt, it, bf = st
         exc_e, exc_m, exc_t = exc
+        # Unrolled-group no-op gate: after mid-group convergence every
+        # push/relabel below is structurally zero (all gated on positive
+        # excess), so the only state this must freeze is the iteration
+        # counter and the global-update branch (whose BF sweeps cost
+        # device time and whose uniform price shift is pointless work).
+        # The budget terms keep max_iter/max_iter_total EXACT despite the
+        # group-level cond (budget exhaustion must stop mid-group too:
+        # the refine gate and exhaustion tests rely on exact counts).
+        active = (
+            (jnp.any(exc_e > 0) | jnp.any(exc_m > 0) | (exc_t > 0))
+            & (it < max_iter)
+            & (total_iters + it < max_iter_total)
+        )
 
         # Price-dependent reduced costs ONCE per iteration (the push sweep
         # freezes prices, so they serve both the push and the relabel).
@@ -475,10 +495,37 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
         # off differently per backend (the BF sweeps dominate op count),
         # so the planner can tune it without minting compile keys.
         pe_new, pm_new, pt_new, sweeps = lax.cond(
-            it % global_every == 0, global_up, local_relabel, operand=None
+            (it % global_every == 0) & active,
+            global_up, local_relabel, operand=None,
         )
 
-        return F, Ffb, Fmt, exc, pe_new, pm_new, pt_new, it + 1, bf + sweeps
+        # Inactive sub-iterations freeze the state EXACTLY.  Convergence
+        # makes the updates above structurally zero, but budget
+        # exhaustion does not (excess remains, pushes/relabels would
+        # fire) — the select is what makes the gate sound for both.
+        F_in, Ffb_in, Fmt_in, exc_in, pe_in, pm_in, pt_in, _it, _bf = st
+
+        def sel(new, old):
+            return jnp.where(active, new, old)
+
+        return (
+            sel(F, F_in), sel(Ffb, Ffb_in), sel(Fmt, Fmt_in),
+            jax.tree_util.tree_map(sel, exc, exc_in),
+            sel(pe_new, pe_in), sel(pm_new, pm_in), sel(pt_new, pt_in),
+            it + active.astype(jnp.int32), bf + sweeps,
+        )
+
+    # ITER_UNROLL iterations per while step: on TPU each lax.while_loop
+    # step pays a fixed sync/predicate cost that at small (churn/
+    # selective) array sizes rivals the body itself; convergence and
+    # budget checks re-run per sub-iteration via the `active` gate, so
+    # arithmetic, budget semantics, and telemetry are all exact — the
+    # group merely runs up to ITER_UNROLL - 1 structurally-no-op
+    # sub-iterations at its tail, which costs device time only.
+    def body(st):
+        for _ in range(ITER_UNROLL):
+            st = iterate(st)
+        return st
 
     exc0 = excesses(F, Ffb, Fmt)
     init = (F, Ffb, Fmt, exc0, pe, pm, pt, jnp.int32(0), jnp.int32(0))
@@ -560,6 +607,30 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
         jnp.all(exc_e == 0) & jnp.all(exc_m == 0) & (exc_t == 0)
     )
     return F, Ffb, prices, iters, bf, clean, phase_iters
+
+
+def _use_fused(e_pad: int, m_pad: int) -> bool:
+    """Route this solve through the fused Pallas ladder kernel?
+
+    Default policy: on an accelerator backend, whenever the working set
+    fits VMEM (transport_fused.fits_vmem) — exactly the small/reduced
+    widths where per-kernel launch overhead dominates the lax path.  On
+    CPU the lax path wins (interpret-mode Pallas is an emulator);
+    POSEIDON_FUSED=1/0 force-overrides for tests and triage.
+    """
+    from poseidon_tpu.ops.transport_fused import fits_vmem
+
+    env = os.environ.get("POSEIDON_FUSED", "")
+    if env == "0":
+        return False
+    if not fits_vmem(e_pad, m_pad):
+        return False
+    if env == "1":
+        return True
+    # TPU backends only ("axon" is the tunneled TPU plugin): the kernel
+    # is Mosaic-lowered pltpu code — a GPU backend must keep the lax
+    # path rather than fail to lower.
+    return jax.default_backend() in ("tpu", "axon")
 
 
 # The epsilon ladder always has this many phases: values are traced (no
@@ -1088,7 +1159,16 @@ def solve_transport(
     if max_iter_total is None:
         max_iter_total = NUM_PHASES * max_iter_per_phase
     _Telemetry.device_calls += 1
-    flows, unsched, prices, iters, bf, clean, phase_iters = _solve_device(
+    solve_fn = _solve_device
+    fused_kw = {}
+    if _use_fused(E_pad, M_pad):
+        from poseidon_tpu.ops.transport_fused import solve_device_fused
+
+        solve_fn = solve_device_fused
+        # Interpret mode on hosts without a Mosaic backend (tests / CPU
+        # fallback with POSEIDON_FUSED=1); compiled on the accelerator.
+        fused_kw = {"interpret": jax.default_backend() == "cpu"}
+    flows, unsched, prices, iters, bf, clean, phase_iters = solve_fn(
         jnp.asarray(costs_p), jnp.asarray(supply_p), jnp.asarray(capacity_p),
         jnp.asarray(unsched_p), jnp.asarray(arc_p),
         jnp.asarray(prices_p),
@@ -1098,7 +1178,7 @@ def solve_transport(
         jnp.int32(max_iter_total),
         jnp.int32(global_update_every),
         jnp.int32(bf_max),
-        max_iter=max_iter_per_phase, scale=int(scale),
+        max_iter=max_iter_per_phase, scale=int(scale), **fused_kw,
     )
     flows = np.asarray(flows)[:E, :M]
     unsched = np.asarray(unsched)[:E]

@@ -1,0 +1,553 @@
+"""Fused Pallas TPU kernel for the cost-scaling push-relabel solve.
+
+Why this exists: the lax implementation in ops/transport.py compiles each
+solver iteration into ~10 separate XLA kernels plus a ``lax.while_loop``
+sync per step.  At large [E, M] that cost amortizes into the arrays; at
+the small/reduced widths the steady-state churn path actually runs
+([<=256 x <=2048] after selective column reduction), fixed per-kernel
+launch and loop-step overhead dominates — measured on the tunneled
+accelerator as TPU churn 6x SLOWER than host CPU at identical iteration
+counts (docs/PERF.md round-3 numbers).  This kernel runs the ENTIRE
+epsilon ladder — all phases, refine + push/relabel/global-update loops —
+as ONE ``pallas_call``: every array lives in VMEM for the whole solve and
+the only launch cost is paid once per solve.
+
+Scope: instances whose working set fits VMEM (``fits_vmem``); larger
+instances keep the lax path, where per-op overhead is already amortized.
+The arithmetic is IDENTICAL to ops/transport.py (same update order, same
+int32 ops) so results are bit-equal — tests assert that in interpret
+mode, and the sharded wrapper's certificates remain valid unchanged.
+
+Replaces (TPU-native): the innermost solver loop of the external cs2 /
+flowlessly min-cost max-flow solvers the reference's Firmament shells out
+to (reference deploy/firmament-deployment.yaml:29-31).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from poseidon_tpu.ops.transport import (
+    _DINF,
+    _NEG,
+    _POS,
+    INF_COST,
+    ITER_UNROLL,
+    NUM_PHASES,
+    _relabel_to,
+)
+
+# VMEM working-set gate: ~10 live [E, M] int32 arrays (C, Uem, F, rc, push
+# temporaries) plus slack must fit the ~16 MB/core budget.  4 bytes * 10 *
+# E*M <= ~12 MB  =>  E*M <= 300k; 2^18 = 262144 keeps headroom and makes
+# the bound a clean shape predicate ([256, 1024], [128, 2048], ...).
+VMEM_ELEM_BUDGET = 1 << 18
+
+
+def fits_vmem(e_pad: int, m_pad: int) -> bool:
+    return e_pad * m_pad <= VMEM_ELEM_BUDGET
+
+
+def _kernel_shape(e_pad: int, m_pad: int):
+    """Kernel operand shape: rows to a sublane multiple (8), columns to a
+    lane multiple (128).  bucket_size produces quarter-octave widths like
+    320 that are not lane-aligned; the extra columns/rows added here are
+    inert by the same construction as the solver's own padding (zero
+    capacity / supply, INF cost)."""
+    return -(-e_pad // 8) * 8, -(-m_pad // 128) * 128
+
+
+def _cumsum_cols(x):
+    """Inclusive cumsum along axis=1 (lanes) by doubling: log2(M) shifted
+    adds — exact int32, identical values to jnp.cumsum, and lowers to
+    plain VPU ops in Mosaic (pltpu.roll is a circular shift; the wrapped
+    lanes are masked off)."""
+    E, M = x.shape
+    col = lax.broadcasted_iota(jnp.int32, (E, M), 1)
+    k = 1
+    while k < M:
+        rolled = pltpu.roll(x, k, axis=1)
+        x = x + jnp.where(col >= k, rolled, 0)
+        k *= 2
+    return x
+
+
+def _cumsum_rows(x):
+    """Inclusive cumsum along axis=0 (sublanes) by doubling."""
+    E, M = x.shape
+    row = lax.broadcasted_iota(jnp.int32, (E, M), 0)
+    k = 1
+    while k < E:
+        rolled = pltpu.roll(x, k, axis=0)
+        x = x + jnp.where(row >= k, rolled, 0)
+        k *= 2
+    return x
+
+
+def _phase_ladder_kernel(
+    # scalar-prefetch / SMEM operands
+    eps_ref,      # SMEM [NUM_PHASES] epsilon ladder
+    knobs_ref,    # SMEM [4]: max_iter, max_iter_total, global_every, bf_max
+    # VMEM inputs
+    C_ref,        # [E, M] scaled costs (INF_COST marks inadmissible)
+    U_ref,        # [E, 1] scaled unscheduled costs
+    sup_ref,      # [E, 1] supplies
+    cap_ref,      # [1, M] column capacities
+    Uem_ref,      # [E, M] per-arc capacity
+    tot_ref,      # [1, 1] total supply
+    F0_ref, Ffb0_ref, Fmt0_ref, pe0_ref, pm0_ref, pt0_ref,
+    # VMEM outputs
+    F_out, Ffb_out, pe_out, pm_out, pt_out, stats_out, phase_out,
+):
+    """The whole ladder in one kernel.
+
+    State lives in the output refs (mutated in place across phases); loop
+    carries are scalars only, which is what Mosaic handles best.
+    ``stats_out`` is [1, 4]: iterations, bf sweeps, clean flag, and the
+    Fmt sink-arc column total is NOT needed outside (recomputed by the
+    host from F) so slot 3 is reserved/zero.  ``phase_out`` is
+    [1, NUM_PHASES] per-phase iteration counts.
+    """
+    E, M = C_ref.shape
+    C = C_ref[:]
+    adm = C < INF_COST
+    U = U_ref[:]
+    supply = sup_ref[:]
+    cap = cap_ref[:]
+    Uem = Uem_ref[:]
+    total = tot_ref[0, 0]
+    max_iter = knobs_ref[0]
+    max_iter_total = knobs_ref[1]
+    global_every = knobs_ref[2]
+    bf_max = knobs_ref[3]
+
+    # Working state starts in the output refs.
+    F_out[:] = F0_ref[:]
+    Ffb_out[:] = Ffb0_ref[:]
+    pe_out[:] = pe0_ref[:]
+    pm_out[:] = pm0_ref[:]
+    pt_out[:] = pt0_ref[:]
+
+    def excesses(F, Ffb, Fmt):
+        exc_e = supply - jnp.sum(F, axis=1, keepdims=True) - Ffb    # [E,1]
+        exc_m = jnp.sum(F, axis=0, keepdims=True) - Fmt             # [1,M]
+        exc_t = jnp.sum(Fmt) + jnp.sum(Ffb) - total                 # scalar
+        return exc_e, exc_m, exc_t
+
+    def global_update(F, Ffb, Fmt, pe, pm, pt, exc_e, exc_m, exc_t, eps):
+        """transport._global_update, 2D-shaped.  Returns new (pe, pm, pt,
+        sweeps)."""
+        def lengths(rc):
+            return jnp.floor_divide(rc, eps) + 1
+
+        rc_em = jnp.where(adm, C + pe - pm, 0)
+        l_em = jnp.where(adm, lengths(rc_em), _DINF)
+        l_me = jnp.where(adm, lengths(-rc_em), _DINF)
+        l_efb = lengths(U + pe - pt)            # [E,1]
+        l_tfb = lengths(-(U + pe - pt))         # [E,1]
+        l_mt = lengths(pm - pt)                 # [1,M]
+        l_tm = lengths(-(pm - pt))              # [1,M]
+
+        has_em = (Uem - F) > 0
+        has_me = F > 0
+        has_efb = (supply - Ffb) > 0
+        has_tfb = Ffb > 0
+        has_mt = (cap - Fmt) > 0
+        has_tm = Fmt > 0
+
+        d_e0 = jnp.where(exc_e < 0, 0, _DINF)           # [E,1]
+        d_m0 = jnp.where(exc_m < 0, 0, _DINF)           # [1,M]
+        d_t0 = jnp.where(exc_t < 0, 0, _DINF)           # scalar
+
+        def sweep(d_e, d_m, d_t):
+            via_m = jnp.min(
+                jnp.where(has_em, l_em + d_m, _DINF), axis=1, keepdims=True
+            )
+            via_t = jnp.where(has_efb, l_efb + d_t, _DINF)
+            d_e_new = jnp.minimum(d_e, jnp.minimum(via_m, via_t))
+            via_e = jnp.min(
+                jnp.where(has_me, l_me + d_e, _DINF), axis=0, keepdims=True
+            )
+            via_t_m = jnp.where(has_mt, l_mt + d_t, _DINF)
+            d_m_new = jnp.minimum(d_m, jnp.minimum(via_e, via_t_m))
+            via_m_t = jnp.min(jnp.where(has_tm, l_tm + d_m, _DINF))
+            via_e_t = jnp.min(jnp.where(has_tfb, l_tfb + d_e, _DINF))
+            d_t_new = jnp.minimum(d_t, jnp.minimum(via_m_t, via_e_t))
+            return d_e_new, d_m_new, d_t_new
+
+        BF_UNROLL = 4
+
+        def bf_cond(st):
+            _d_e, _d_m, _d_t, changed, it = st
+            return changed & (it <= bf_max)
+
+        def bf_body(st):
+            d_e, d_m, d_t, _c, it = st
+            d_e_new, d_m_new, d_t_new = d_e, d_m, d_t
+            for _ in range(BF_UNROLL):
+                d_e_new, d_m_new, d_t_new = sweep(d_e_new, d_m_new, d_t_new)
+            changed = (
+                jnp.any(d_e_new != d_e) | jnp.any(d_m_new != d_m)
+                | (d_t_new != d_t)
+            )
+            return d_e_new, d_m_new, d_t_new, changed, it + BF_UNROLL
+
+        d_e, d_m, d_t, changed, sweeps = lax.while_loop(
+            bf_cond, bf_body,
+            (d_e0, d_m0, d_t0.astype(jnp.int32), jnp.bool_(True),
+             jnp.int32(0)),
+        )
+
+        finite_max = jnp.maximum(
+            jnp.maximum(
+                jnp.max(jnp.where(d_e < _DINF, d_e, 0)),
+                jnp.max(jnp.where(d_m < _DINF, d_m, 0)),
+            ),
+            jnp.where(d_t < _DINF, d_t, 0),
+        )
+        dbig = finite_max + 1
+        d_e = jnp.where(d_e >= _DINF, dbig, d_e)
+        d_m = jnp.where(d_m >= _DINF, dbig, d_m)
+        d_t = jnp.where(d_t >= _DINF, dbig, d_t)
+
+        ok = ~changed & (finite_max < (1 << 26) // jnp.maximum(eps, 1))
+        pe_new = jnp.where(ok, jnp.maximum(pe - eps * d_e, _NEG // 2), pe)
+        pm_new = jnp.where(ok, jnp.maximum(pm - eps * d_m, _NEG // 2), pm)
+        pt_new = jnp.where(ok, jnp.maximum(pt - eps * d_t, _NEG // 2), pt)
+        return pe_new, pm_new, pt_new, sweeps
+
+    # Fmt is carried across phases exactly as the lax path carries it;
+    # it gets its own VMEM scratch home via run_scoped (all other state
+    # lives in the output refs).
+    def _ladder(Fmt_scr):
+        Fmt_scr[:] = Fmt0_ref[:]
+
+        def phase_body(k, carry):
+            tot_it, tot_bf = carry
+            eps = eps_ref[k]
+            F_in = F_out[:]
+            Ffb_in = Ffb_out[:]
+            Fmt_in = Fmt_scr[:]
+            pe = pe_out[:]
+            pm = pm_out[:]
+            pt = pt_out[:]
+
+            budget_left = tot_it + 64 < max_iter_total
+
+            def refine(rc, flow, hi):
+                ref = jnp.where(rc < -eps, hi, jnp.where(rc > eps, 0, flow))
+                return jnp.where(budget_left, ref, flow)
+
+            rc_em0 = jnp.where(adm, C + pe - pm, _POS)
+            F = refine(rc_em0, F_in, Uem)
+            Ffb = refine(U + pe - pt, Ffb_in, supply)
+            Fmt = refine(pm - pt, Fmt_in, cap)
+
+            exc_e, exc_m, exc_t = excesses(F, Ffb, Fmt)
+
+            def cond(st):
+                (_F, _Ffb, _Fmt, exc_e, exc_m, exc_t,
+                 _pe, _pm, _pt, it, _bf) = st
+                active = (
+                    jnp.any(exc_e > 0) | jnp.any(exc_m > 0) | (exc_t > 0)
+                )
+                return (
+                    (it < max_iter)
+                    & (tot_it + it < max_iter_total)
+                    & active
+                )
+
+            def iterate(st):
+                F, Ffb, Fmt, exc_e, exc_m, exc_t, pe, pm, pt, it, bf = st
+                # Convergence AND budget per sub-iteration (exact budget
+                # semantics despite the group-level while cond) — same
+                # gate as the lax path.
+                active = (
+                    (jnp.any(exc_e > 0) | jnp.any(exc_m > 0)
+                     | (exc_t > 0))
+                    & (it < max_iter)
+                    & (tot_it + it < max_iter_total)
+                )
+
+                rc_em = jnp.where(adm, C + pe - pm, _POS)
+                rc_fb = U + pe - pt            # [E,1]
+                rc_mt = pm - pt                # [1,M]
+
+                # === push sweep (same allocation order as the lax path:
+                # machine arcs in column order, then fallback; sink arc,
+                # then reverse EC arcs in row order; sink row machines
+                # first then EC fallbacks). ===
+                res_em = jnp.where((rc_em < 0) & (exc_e > 0), Uem - F, 0)
+                before = _cumsum_cols(res_em) - res_em
+                ec_push = jnp.clip(
+                    jnp.minimum(res_em, exc_e - before), 0, None
+                )
+                left_e = exc_e - jnp.sum(ec_push, axis=1, keepdims=True)
+                fb_push = jnp.where(
+                    (rc_fb < 0) & (left_e > 0),
+                    jnp.minimum(supply - Ffb, left_e), 0,
+                )
+
+                mt_push = jnp.where(
+                    (rc_mt < 0) & (exc_m > 0),
+                    jnp.minimum(cap - Fmt, exc_m), 0,
+                )
+                left_m = exc_m - mt_push
+                res_me = jnp.where((rc_em > 0) & (left_m > 0), F, 0)
+                before_me = _cumsum_rows(res_me) - res_me
+                me_push = jnp.clip(
+                    jnp.minimum(res_me, left_m - before_me), 0, None
+                )
+
+                # Sink row: the lax path cumsums over concat([Fmt, Ffb])
+                # (machines first).  Same order without the concat: the
+                # EC part's prefix is offset by the machine part's total.
+                texc = jnp.where(exc_t > 0, 1, 0)
+                res_t_m = jnp.where((-rc_mt < 0), Fmt, 0) * texc
+                before_tm = _cumsum_cols(res_t_m) - res_t_m
+                t_push_m = jnp.clip(
+                    jnp.minimum(res_t_m, exc_t - before_tm), 0, None
+                )
+                res_t_e = jnp.where((-rc_fb < 0), Ffb, 0) * texc
+                before_te = (
+                    _cumsum_rows(res_t_e) - res_t_e + jnp.sum(res_t_m)
+                )
+                t_push_e = jnp.clip(
+                    jnp.minimum(res_t_e, exc_t - before_te), 0, None
+                )
+
+                F = F + ec_push - me_push
+                Ffb = Ffb + fb_push - t_push_e
+                Fmt = Fmt + mt_push - t_push_m
+
+                exc_e, exc_m, exc_t = excesses(F, Ffb, Fmt)
+
+                def local_relabel(_):
+                    res_em2 = Uem - F
+                    has_em = res_em2 > 0
+                    fb_open = supply - Ffb > 0
+                    has_adm_e = (
+                        jnp.any(
+                            (rc_em < 0) & has_em, axis=1, keepdims=True
+                        )
+                        | ((rc_fb < 0) & fb_open)
+                    )
+                    maxcand_e = jnp.maximum(
+                        jnp.max(
+                            jnp.where(has_em & adm, pm - C, _NEG),
+                            axis=1, keepdims=True,
+                        ),
+                        jnp.where(fb_open, pt - U, _NEG),
+                    )
+                    pe_new = _relabel_to(maxcand_e, has_adm_e, exc_e, pe,
+                                         eps)
+
+                    mt_open = cap - Fmt > 0
+                    has_adm_m = (
+                        ((rc_mt < 0) & mt_open)
+                        | jnp.any((rc_em > 0) & (F > 0), axis=0,
+                                  keepdims=True)
+                    )
+                    maxcand_m = jnp.maximum(
+                        jnp.where(mt_open, pt, _NEG),
+                        jnp.max(
+                            jnp.where((F > 0) & adm, pe + C, _NEG),
+                            axis=0, keepdims=True,
+                        ),
+                    )
+                    pm_new = _relabel_to(maxcand_m, has_adm_m, exc_m, pm,
+                                         eps)
+
+                    # Sink relabel over concat([pm, pe + U]) with
+                    # residuals concat([Fmt, Ffb]).
+                    has_adm_t = (
+                        jnp.any((-rc_mt < 0) & (Fmt > 0))
+                        | jnp.any((-rc_fb < 0) & (Ffb > 0))
+                    )
+                    maxcand_t = jnp.maximum(
+                        jnp.max(jnp.where(Fmt > 0, pm, _NEG)),
+                        jnp.max(jnp.where(Ffb > 0, pe + U, _NEG)),
+                    )
+                    pt_new = _relabel_to(
+                        maxcand_t, has_adm_t, exc_t, pt, eps
+                    )
+                    return pe_new, pm_new, pt_new, jnp.int32(0)
+
+                def global_up(_):
+                    return global_update(
+                        F, Ffb, Fmt, pe, pm, pt, exc_e, exc_m, exc_t, eps
+                    )
+
+                pe_new, pm_new, pt_new, sweeps = lax.cond(
+                    (it % global_every == 0) & active,
+                    global_up, local_relabel, operand=None,
+                )
+
+                # Inactive sub-iterations freeze the state EXACTLY (the
+                # excess gates cover convergence but not budget
+                # exhaustion) — same select as the lax path.
+                (F_in, Ffb_in, Fmt_in, ee_in, em_in, et_in,
+                 pe_in, pm_in, pt_in, _it, _bf) = st
+
+                def sel(new, old):
+                    return jnp.where(active, new, old)
+
+                return (
+                    sel(F, F_in), sel(Ffb, Ffb_in), sel(Fmt, Fmt_in),
+                    sel(exc_e, ee_in), sel(exc_m, em_in),
+                    sel(exc_t, et_in),
+                    sel(pe_new, pe_in), sel(pm_new, pm_in),
+                    sel(pt_new, pt_in),
+                    it + active.astype(jnp.int32), bf + sweeps,
+                )
+
+            def body(st):
+                for _ in range(ITER_UNROLL):
+                    st = iterate(st)
+                return st
+
+            init = (F, Ffb, Fmt, exc_e, exc_m, exc_t, pe, pm, pt,
+                    jnp.int32(0), jnp.int32(0))
+            (F, Ffb, Fmt, _ee, _em, _et, pe, pm, pt, iters, bf) = (
+                lax.while_loop(cond, body, init)
+            )
+            F_out[:] = F
+            Ffb_out[:] = Ffb
+            Fmt_scr[:] = Fmt
+            pe_out[:] = pe
+            pm_out[:] = pm
+            pt_out[:] = pt
+            phase_out[0, k] = iters
+            return tot_it + iters, tot_bf + bf
+
+        tot_it, tot_bf = lax.fori_loop(
+            0, NUM_PHASES, phase_body, (jnp.int32(0), jnp.int32(0))
+        )
+
+        exc_e, exc_m, exc_t = excesses(F_out[:], Ffb_out[:], Fmt_scr[:])
+        clean = (
+            jnp.all(exc_e == 0) & jnp.all(exc_m == 0) & (exc_t == 0)
+        )
+        stats_out[0, 0] = tot_it
+        stats_out[0, 1] = tot_bf
+        stats_out[0, 2] = clean.astype(jnp.int32)
+        stats_out[0, 3] = jnp.int32(0)
+
+    pl.run_scoped(_ladder, pltpu.VMEM((1, M), jnp.int32))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_iter", "scale", "interpret")
+)
+def solve_device_fused(costs, supply, capacity, unsched_cost, arc_cap,
+                       init_prices, init_flows, init_fb, eps_sched,
+                       max_iter_total, global_every, bf_max, *,
+                       max_iter, scale, interpret=False):
+    """Drop-in twin of transport._solve_device running the ladder as one
+    Pallas kernel.  Same operand contract, same outputs
+    ``(F, Ffb, prices, iters, bf, clean, phase_iters)``; results are
+    bit-identical to the lax path (asserted by tests in interpret mode).
+
+    Callers guarantee ``fits_vmem(E, M)``; operands are re-padded here to
+    kernel alignment (rows to 8, lanes to 128) with inert rows/columns
+    and stripped on return.
+    """
+    E, M = costs.shape
+    Ek, Mk = _kernel_shape(E, M)
+
+    # Host-side (traced, one-time) preprocessing — identical to
+    # _solve_device.
+    def pad2(x, fill):
+        return jnp.pad(x, ((0, Ek - E), (0, Mk - M)),
+                       constant_values=fill)
+
+    costs_k = pad2(costs, INF_COST)
+    C = jnp.where(
+        costs_k >= INF_COST, INF_COST, costs_k * scale
+    ).astype(jnp.int32)
+    supply_k = jnp.pad(supply.astype(jnp.int32), (0, Ek - E))
+    cap_k = jnp.pad(capacity.astype(jnp.int32), (0, Mk - M))
+    # Padded unscheduled cost 1 (matches solve_transport's padding).
+    U = jnp.pad(
+        (unsched_cost * scale).astype(jnp.int32), (0, Ek - E),
+        constant_values=scale,
+    )
+    total = jnp.sum(supply_k)
+    Uem = jnp.minimum(
+        jnp.minimum(supply_k[:, None], cap_k[None, :]),
+        pad2(arc_cap.astype(jnp.int32), 0),
+    )
+
+    pe = jnp.pad(init_prices[:E], (0, Ek - E))
+    pm = jnp.pad(init_prices[E:E + M], (0, Mk - M))
+    pt = init_prices[E + M]
+
+    F0 = jnp.clip(pad2(init_flows, 0), 0, Uem)
+    F0 = jnp.where(costs_k < INF_COST, F0, 0)
+    F0 = jnp.where(
+        (jnp.sum(F0, axis=1) <= supply_k)[:, None], F0, 0
+    )
+    Ffb0 = jnp.clip(
+        jnp.pad(init_fb, (0, Ek - E)), 0,
+        supply_k - jnp.sum(F0, axis=1),
+    )
+    Fmt0 = jnp.minimum(jnp.sum(F0, axis=0), cap_k)
+
+    knobs = jnp.stack([
+        jnp.int32(max_iter),
+        jnp.asarray(max_iter_total, jnp.int32),
+        jnp.asarray(global_every, jnp.int32),
+        jnp.asarray(bf_max, jnp.int32),
+    ])
+
+    out_shapes = (
+        jax.ShapeDtypeStruct((Ek, Mk), jnp.int32),          # F
+        jax.ShapeDtypeStruct((Ek, 1), jnp.int32),           # Ffb
+        jax.ShapeDtypeStruct((Ek, 1), jnp.int32),           # pe
+        jax.ShapeDtypeStruct((1, Mk), jnp.int32),           # pm
+        jax.ShapeDtypeStruct((1, 1), jnp.int32),            # pt
+        jax.ShapeDtypeStruct((1, 4), jnp.int32),            # stats
+        jax.ShapeDtypeStruct((1, NUM_PHASES), jnp.int32),   # phase iters
+    )
+    vm = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    F, Ffb, pe_o, pm_o, pt_o, stats, phase_iters = pl.pallas_call(
+        _phase_ladder_kernel,
+        out_shape=out_shapes,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # eps_sched
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # knobs
+            vm(), vm(), vm(), vm(), vm(), vm(),      # C U sup cap Uem tot
+            vm(), vm(), vm(), vm(), vm(), vm(),      # F0 Ffb0 Fmt0 pe pm pt
+        ],
+        out_specs=tuple(vm() for _ in out_shapes),
+        interpret=interpret,
+    )(
+        eps_sched.astype(jnp.int32),
+        knobs,
+        C,
+        U[:, None],
+        supply_k[:, None],
+        cap_k[None, :],
+        Uem,
+        total.reshape(1, 1).astype(jnp.int32),
+        F0,
+        Ffb0[:, None],
+        Fmt0[None, :],
+        pe[:, None],
+        pm[None, :],
+        pt[None, None],
+    )
+    prices = jnp.concatenate(
+        [pe_o[:E, 0], pm_o[0, :M], pt_o[0]]
+    )
+    return (
+        F[:E, :M], Ffb[:E, 0], prices,
+        stats[0, 0], stats[0, 1], stats[0, 2].astype(jnp.bool_),
+        phase_iters[0],
+    )

@@ -1,0 +1,113 @@
+"""Fused Pallas ladder kernel: BIT-parity with the lax solver path.
+
+The fused kernel (ops/transport_fused.py) re-implements the exact same
+int32 update sequence as ops/transport.py's ``_solve_device``, so on any
+instance its flows, prices, iteration counts, BF sweeps, and per-phase
+splits must be IDENTICAL — not merely cost-equal.  These tests run the
+kernel in Pallas interpret mode (no TPU in CI) via POSEIDON_FUSED=1.
+"""
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.ops import transport
+from poseidon_tpu.ops.transport import solve_transport
+from poseidon_tpu.ops.transport_fused import _kernel_shape, fits_vmem
+
+
+def _instance(E, M, seed, contended=False):
+    rng = np.random.default_rng(seed)
+    costs = rng.integers(0, 1000, size=(E, M)).astype(np.int32)
+    costs[rng.random((E, M)) < 0.1] = transport.INF_COST
+    supply = rng.integers(1, 9, size=E).astype(np.int32)
+    cap = (
+        np.full(M, max(1, int(supply.sum()) // (2 * M) + 1), np.int32)
+        if contended
+        else rng.integers(1, 12, size=M).astype(np.int32)
+    )
+    unsched = rng.integers(1000, 2000, size=E).astype(np.int32)
+    arc = rng.integers(1, 6, size=(E, M)).astype(np.int32)
+    return costs, supply, cap, unsched, arc
+
+
+def _solve_both(monkeypatch, *args, **kw):
+    monkeypatch.setenv("POSEIDON_FUSED", "0")
+    lax_sol = solve_transport(*args, **kw)
+    monkeypatch.setenv("POSEIDON_FUSED", "1")
+    fused_sol = solve_transport(*args, **kw)
+    return lax_sol, fused_sol
+
+
+def _assert_bit_equal(a, b):
+    np.testing.assert_array_equal(a.flows, b.flows)
+    np.testing.assert_array_equal(a.unsched, b.unsched)
+    np.testing.assert_array_equal(a.prices, b.prices)
+    assert a.objective == b.objective
+    assert a.gap_bound == b.gap_bound
+    assert a.iterations == b.iterations
+    assert a.bf_sweeps == b.bf_sweeps
+    assert a.phase_iters == b.phase_iters
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_fused_bit_parity_cold(monkeypatch, seed):
+    costs, supply, cap, unsched, arc = _instance(24, 96, seed)
+    a, b = _solve_both(
+        monkeypatch, costs, supply, cap, unsched, arc_capacity=arc
+    )
+    _assert_bit_equal(a, b)
+    assert a.gap_bound == 0.0
+
+
+def test_fused_bit_parity_contended(monkeypatch):
+    # Contention drives long multi-phase ladders with global updates and
+    # sink push-back — the full code path surface.
+    costs, supply, cap, unsched, arc = _instance(16, 64, 7, contended=True)
+    a, b = _solve_both(
+        monkeypatch, costs, supply, cap, unsched, arc_capacity=arc
+    )
+    _assert_bit_equal(a, b)
+    assert a.iterations > 0
+
+
+def test_fused_bit_parity_warm_start(monkeypatch):
+    costs, supply, cap, unsched, arc = _instance(16, 64, 11)
+    monkeypatch.setenv("POSEIDON_FUSED", "0")
+    first = solve_transport(
+        costs, supply, cap, unsched, arc_capacity=arc
+    )
+    # Drift the costs, then warm-start both paths from the same frame.
+    costs2 = np.where(
+        costs < transport.INF_COST, costs + 3, costs
+    ).astype(np.int32)
+    kw = dict(
+        arc_capacity=arc, init_flows=first.flows,
+        init_unsched=first.unsched, eps_start=4 * 97,
+    )
+    a, b = _solve_both(
+        monkeypatch, costs2, supply, cap, unsched, first.prices, **kw
+    )
+    _assert_bit_equal(a, b)
+
+
+def test_fused_bit_parity_unaligned_bucket(monkeypatch):
+    # M=280 pads to bucket 320, which is NOT lane-aligned (320 % 128 !=
+    # 0): the kernel re-pads to 384 with inert columns — results must be
+    # unchanged.
+    costs, supply, cap, unsched, arc = _instance(10, 280, 13)
+    a, b = _solve_both(
+        monkeypatch, costs, supply, cap, unsched, arc_capacity=arc
+    )
+    _assert_bit_equal(a, b)
+
+
+def test_kernel_shape_alignment():
+    assert _kernel_shape(8, 320) == (8, 384)
+    assert _kernel_shape(10, 128) == (16, 128)
+    assert _kernel_shape(256, 1024) == (256, 1024)
+
+
+def test_fits_vmem_gate():
+    assert fits_vmem(256, 1024)
+    assert fits_vmem(128, 2048)
+    assert not fits_vmem(256, 10240)  # the 10k full-wave width
