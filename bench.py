@@ -168,6 +168,28 @@ def submit_population(state, num_tasks: int, num_ecs: int, seed: int):
         )
 
 
+def churn_step(state, rng, frac: int = 100):
+    """Replace 1/frac of the tasks with same-shape resubmissions — the
+    steady-state churn step, shared by the measured churn loop and the
+    restart-recovery measurement so both see identical semantics."""
+    from poseidon_tpu.graph.state import TaskInfo
+
+    uids = list(state.tasks.keys())
+    pick = rng.choice(len(uids), size=max(1, len(uids) // frac),
+                      replace=False)
+    for k in pick:
+        uid = uids[k]
+        t = state.tasks.get(uid)
+        if t is None:
+            continue
+        state.task_removed(uid)
+        state.task_submitted(
+            TaskInfo(uid=uid, job_id=t.job_id,
+                     cpu_request=t.cpu_request,
+                     ram_request=t.ram_request)
+        )
+
+
 def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
              verbose: bool) -> dict:
     """One ladder rung: cold round, fresh-population waves, churn rounds."""
@@ -175,7 +197,6 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
 
     from poseidon_tpu.costmodel import get_cost_model
     from poseidon_tpu.graph.instance import RoundPlanner
-    from poseidon_tpu.graph.state import TaskInfo
 
     backend = jax.devices()[0].platform
     # cold_s honesty: report whether this child started with a non-empty
@@ -252,21 +273,8 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
     # Steady-state churn: replace 1% of tasks per round.
     rng = np.random.default_rng(12345)
     churn_lat = []
-    uids = list(state.tasks.keys())
     for r in range(rounds):
-        pick = rng.choice(len(uids), size=max(1, len(uids) // 100),
-                          replace=False)
-        for k in pick:
-            uid = uids[k]
-            t = state.tasks.get(uid)
-            if t is None:
-                continue
-            state.task_removed(uid)
-            state.task_submitted(
-                TaskInfo(uid=uid, job_id=t.job_id,
-                         cpu_request=t.cpu_request,
-                         ram_request=t.ram_request)
-            )
+        churn_step(state, rng)
         t0 = time.perf_counter()
         _, metrics = planner.schedule_round()
         dt = time.perf_counter() - t0
@@ -279,6 +287,25 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
                   f"calls={metrics.device_calls}",
                   file=sys.stderr)
 
+    # Recovery-to-first-placement: checkpoint the live state (placements
+    # + solver warm frames), restore into a FRESH planner, apply one
+    # churn step (a restart never lands on a perfectly quiet cluster),
+    # and time the first round.  Within-process, so XLA compile cache is
+    # warm — which matches a restarted service with the persistent
+    # on-disk cache (envutil.enable_compilation_cache).
+    import tempfile
+
+    from poseidon_tpu.graph.snapshot import load_checkpoint, save_checkpoint
+
+    with tempfile.TemporaryDirectory() as td:
+        ckpt = os.path.join(td, "bench.ckpt")
+        save_checkpoint(state, planner, ckpt)
+        state_r, planner_r = load_checkpoint(ckpt)
+        churn_step(state_r, rng)
+        t0 = time.perf_counter()
+        _, m_restart = planner_r.schedule_round()
+        restart_s = time.perf_counter() - t0
+
     return {
         "machines": machines,
         "tasks": tasks,
@@ -288,6 +315,8 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
         "precompile_s": round(precompile_s, 4),
         "wave_p50_s": round(float(np.percentile(wave_lat, 50)), 4),
         "churn_p50_s": round(float(np.percentile(churn_lat, 50)), 4),
+        "restart_round_s": round(restart_s, 4),
+        "restart_iters": m_restart.iterations,
         "placed": placed,
         "unscheduled": unsched,
         "objective": objective,

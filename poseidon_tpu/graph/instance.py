@@ -271,6 +271,55 @@ class RoundPlanner:
         self._last_unscheduled = 1  # force a solve on the first round
         self.last_metrics = RoundMetrics()
 
+    # ------------------------------------------------------------- warm frames
+
+    def export_warm_state(self) -> dict:
+        """Serialize per-band warm frames (prices/flows/costs) to a flat
+        {key: np.ndarray} dict (npz-compatible).
+
+        A restarted service that restores these solves its first round
+        WARM: with an unchanged pending backlog the drift epsilon is the
+        scale floor and the solve certifies in near-zero iterations,
+        instead of re-paying the cold ladder on the whole backlog
+        (round-3 review: ~30 s to first placement at 10k scale).
+        """
+        out: dict = {}
+        for band, w in self._warm_bands.items():
+            if w.prices is None:
+                continue
+            p = f"b{band}."
+            out[p + "ec_ids"] = np.asarray(w.ec_ids, dtype=np.int64)
+            out[p + "machine_uuids"] = np.asarray(w.machine_uuids)
+            out[p + "prices"] = w.prices
+            out[p + "flows"] = w.flows
+            out[p + "unsched"] = w.unsched
+            out[p + "costs"] = w.costs
+            out[p + "unsched_cost"] = w.unsched_cost
+        return out
+
+    def import_warm_state(self, frames: dict) -> int:
+        """Restore frames exported by ``export_warm_state``; returns the
+        number of bands restored."""
+        bands: Dict[int, _WarmState] = {}
+        for key in frames:
+            if not key.endswith(".prices"):
+                continue
+            band = int(key.split(".", 1)[0][1:])
+            p = f"b{band}."
+            bands[band] = _WarmState(
+                ec_ids=[int(e) for e in frames[p + "ec_ids"]],
+                machine_uuids=[str(u) for u in frames[p + "machine_uuids"]],
+                prices=np.asarray(frames[p + "prices"], dtype=np.int32),
+                flows=np.asarray(frames[p + "flows"], dtype=np.int32),
+                unsched=np.asarray(frames[p + "unsched"], dtype=np.int32),
+                costs=np.asarray(frames[p + "costs"], dtype=np.int64),
+                unsched_cost=np.asarray(
+                    frames[p + "unsched_cost"], dtype=np.int64
+                ),
+            )
+        self._warm_bands.update(bands)
+        return len(bands)
+
     # ---------------------------------------------------------------- solving
 
     def _dispatch_solve(self, costs, supply, capacity, unsched_cost,

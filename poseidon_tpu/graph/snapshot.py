@@ -102,7 +102,17 @@ def save_state(state: ClusterState, path: Union[str, Path]) -> None:
             ],
             "tasks": [_task_to_dict(t) for t in state.tasks.values()],
         }
-    Path(path).write_text(json.dumps(doc))
+    _atomic_write(Path(path), json.dumps(doc).encode())
+
+
+def _atomic_write(path: Path, data: bytes) -> None:
+    """Temp file + rename: a crash mid-checkpoint must leave the previous
+    checkpoint intact, never a truncated file the next start chokes on."""
+    import os
+
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_bytes(data)
+    os.replace(tmp, path)
 
 
 def load_state(path: Union[str, Path],
@@ -148,3 +158,59 @@ def load_state(path: Union[str, Path],
     state.apply_placements(placements)
     state.round_index = int(doc.get("round_index", 0))
     return state
+
+
+def save_checkpoint(state: ClusterState, planner, path: Union[str, Path]):
+    """Full service checkpoint: cluster state (JSON) + the planner's
+    solver warm frames (compressed npz at ``<path>.warm.npz``).
+
+    The warm frames are what make recovery fast: restoring state alone
+    re-pays the cold epsilon ladder on whatever backlog was pending at
+    snapshot time (round-3 review weak #3 — ~30 s to first placement at
+    10k scale), while a restored frame solves the unchanged backlog at
+    the drift-epsilon floor in near-zero iterations.
+    """
+    import numpy as np
+
+    save_state(state, path)
+    frames = planner.export_warm_state()
+    warm_path = Path(str(path) + ".warm.npz")
+    if frames:
+        import io
+
+        buf = io.BytesIO()
+        np.savez_compressed(buf, **frames)
+        _atomic_write(warm_path, buf.getvalue())
+    elif warm_path.exists():
+        warm_path.unlink()  # stale frames must not outlive their state
+
+
+def load_checkpoint(path: Union[str, Path], cost_model=None,
+                    use_native: bool = True, **planner_kw):
+    """Restore ``(state, planner)`` from a checkpoint.
+
+    ``cost_model`` defaults to the CPU/Mem model (the reference's active
+    one).  Warm frames are restored when present; a missing/corrupt
+    frames file degrades to cold-start (never a restore failure — the
+    frames are an optimization, the state is the truth).
+    """
+    import numpy as np
+
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+
+    state = load_state(path, use_native=use_native)
+    planner = RoundPlanner(
+        state, cost_model or get_cost_model("cpu_mem"), **planner_kw
+    )
+    warm_path = Path(str(path) + ".warm.npz")
+    if warm_path.exists():
+        try:
+            with np.load(warm_path, allow_pickle=False) as frames:
+                planner.import_warm_state(dict(frames))
+        except Exception:  # noqa: BLE001 - frames are an optimization
+            # Degrade to cold-start on ANY frame damage (np.load raises
+            # zipfile.BadZipFile on a truncated archive, outside the
+            # obvious OSError/ValueError set); placements stay intact.
+            pass
+    return state, planner

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import signal
 import threading
 from concurrent import futures
@@ -47,13 +48,46 @@ class FirmamentServicer:
         config: Optional[FirmamentTPUConfig] = None,
     ) -> None:
         self.config = config or FirmamentTPUConfig()
-        self.state = state or ClusterState()
-        self.planner = planner or RoundPlanner(
-            self.state, get_cost_model(self.config.cost_model),
+        planner_kw = dict(
             gang_scheduling=self.config.gang_scheduling,
             pod_affinity=self.config.pod_affinity,
             solver_devices=self.config.solver_devices,
             flow_solver=self.config.flow_solver,
+        )
+        if (
+            state is None and planner is None
+            and self.config.checkpoint_path
+            and os.path.exists(self.config.checkpoint_path)
+        ):
+            # Restart recovery: placements AND solver warm frames come
+            # back, so the first round solves warm instead of re-paying
+            # the cold ladder on the standing backlog.  An unreadable
+            # checkpoint degrades to a fresh start (the client re-plays
+            # its world onto ALREADY_* replies) — recovery must never be
+            # the reason the scheduler cannot start.
+            from poseidon_tpu.graph.snapshot import load_checkpoint
+
+            try:
+                state, planner = load_checkpoint(
+                    self.config.checkpoint_path,
+                    cost_model=get_cost_model(self.config.cost_model),
+                    **planner_kw,
+                )
+                log.info(
+                    "restored checkpoint %s: %d machines, %d tasks, "
+                    "%d warm bands", self.config.checkpoint_path,
+                    len(state.machines), len(state.tasks),
+                    len(planner._warm_bands),
+                )
+            except Exception as e:  # noqa: BLE001 - degrade, don't die
+                log.error(
+                    "checkpoint %s unreadable (%s); starting fresh",
+                    self.config.checkpoint_path, e,
+                )
+                state = planner = None
+        self.state = state or ClusterState()
+        self.planner = planner or RoundPlanner(
+            self.state, get_cost_model(self.config.cost_model), **planner_kw
         )
         # Schedule() rounds are serialized: the planner's warm-start state
         # is single-writer (the reference client also calls Schedule from
@@ -93,7 +127,32 @@ class FirmamentServicer:
             metrics.total_seconds, metrics.objective,
             metrics.iterations, metrics.bf_sweeps, metrics.device_calls,
         )
+        every = self.config.checkpoint_every_rounds
+        if (
+            self.config.checkpoint_path and every > 0
+            and metrics.round_index % every == every - 1
+        ):
+            self.save_checkpoint()
         return converters.deltas_to_proto(deltas)
+
+    def save_checkpoint(self) -> None:
+        """Write state + warm frames; failures are logged, never fatal
+        (a scheduler that dies because its checkpoint disk filled up
+        would be worse than one that restarts cold).  Takes the schedule
+        lock: _warm_bands mutates during a round, and a checkpoint torn
+        across a concurrent round would pair one round's state with
+        another's frames."""
+        if not self.config.checkpoint_path:
+            return
+        from poseidon_tpu.graph.snapshot import save_checkpoint
+
+        try:
+            with self._schedule_lock:
+                save_checkpoint(
+                    self.state, self.planner, self.config.checkpoint_path
+                )
+        except OSError as e:
+            log.error("checkpoint write failed: %s", e)
 
     # ----------------------------------------------------------- task lifecycle
 
@@ -257,6 +316,9 @@ def main(argv=None) -> None:
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
     server.stop(grace=2.0)
+    # Shutdown checkpoint AFTER the server quiesces: the final state
+    # (placements + warm frames) is what the next start restores.
+    server.servicer.save_checkpoint()
 
 
 if __name__ == "__main__":

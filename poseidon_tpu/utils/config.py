@@ -75,6 +75,13 @@ class FirmamentTPUConfig:
     # When set, each Schedule() round is captured with the JAX profiler
     # into this directory (xprof trace; SURVEY.md section 5).
     profile_dir: str = ""
+    # Checkpoint/restore (exceeds the reference, whose state is in-memory
+    # only — HA is its explicit roadmap gap, README.md:67): when set, the
+    # service restores state + solver warm frames from this path at
+    # startup and saves on shutdown; checkpoint_every_rounds > 0 also
+    # saves after every Nth Schedule() round.
+    checkpoint_path: str = ""
+    checkpoint_every_rounds: int = 0
     config_file: str = ""
 
 

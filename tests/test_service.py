@@ -195,3 +195,45 @@ def test_utilization_steers_placement(client):
     client.task_submitted(td, jd)
     (delta,) = client.schedule()
     assert delta.resource_id == cold
+
+
+def test_service_checkpoint_roundtrip(tmp_path):
+    """checkpoint_path config: a new servicer over the same path restores
+    placements and warm frames (the restart-recovery path the reference
+    lacks -- its README.md:67 lists HA as roadmap)."""
+    from poseidon_tpu.protos import firmament_pb2 as fpb
+    from poseidon_tpu.service.server import FirmamentServicer
+    from poseidon_tpu.utils.config import FirmamentTPUConfig
+    from poseidon_tpu.utils.ids import generate_uuid, hash_combine
+
+    ckpt = str(tmp_path / "svc.ckpt")
+    cfg = FirmamentTPUConfig(checkpoint_path=ckpt)
+    sv = FirmamentServicer(config=cfg)
+    for i in range(3):
+        rtnd = fpb.ResourceTopologyNodeDescriptor()
+        rd = rtnd.resource_desc
+        rd.uuid = generate_uuid(f"ck-m{i}")
+        rd.type = fpb.ResourceDescriptor.RESOURCE_MACHINE
+        rd.resource_capacity.cpu_cores = 4000
+        rd.resource_capacity.ram_cap = 1 << 24
+        rd.task_capacity = 10
+        sv.NodeAdded(rtnd, None)
+    for i in range(5):
+        req = fpb.TaskDescription()
+        req.task_descriptor.uid = hash_combine(99, i)
+        req.task_descriptor.name = f"ck-{i}"
+        req.task_descriptor.resource_request.cpu_cores = 100
+        req.task_descriptor.resource_request.ram_cap = 1 << 20
+        req.job_descriptor.uuid = "ck-job"
+        sv.TaskSubmitted(req, None)
+    deltas = sv.Schedule(fpb.ScheduleRequest(), None)
+    assert len(deltas.deltas) == 5
+    sv.save_checkpoint()
+
+    sv2 = FirmamentServicer(config=cfg)
+    placed = {t.uid: t.scheduled_to for t in sv2.state.tasks.values()
+              if t.scheduled_to}
+    assert len(placed) == 5
+    # A quiet restored round re-places nothing.
+    deltas2 = sv2.Schedule(fpb.ScheduleRequest(), None)
+    assert len(deltas2.deltas) == 0

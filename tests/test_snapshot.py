@@ -44,3 +44,75 @@ def test_roundtrip_preserves_schedule(tmp_path):
     planner2 = RoundPlanner(st2, get_cost_model("cpu_mem"))
     deltas, m = planner2.schedule_round()
     assert deltas == [] and m.unscheduled == 0
+
+
+def test_checkpoint_restores_warm_frames_and_solves_warm(tmp_path):
+    """A restored service's first round must solve WARM: same pending
+    backlog => drift-epsilon floor => far fewer iterations than the cold
+    ladder a frame-less restore pays (round-3 weak #3)."""
+    import numpy as np
+
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+    from poseidon_tpu.graph.snapshot import load_checkpoint, save_checkpoint
+    from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+    from poseidon_tpu.utils.ids import task_uid
+
+    rng = np.random.default_rng(0)
+    state = ClusterState()
+    # Contended: capacity holds ~half the backlog, so every round keeps a
+    # pending remainder — the state where warm frames pay off.
+    for i in range(60):
+        state.node_added(MachineInfo(
+            uuid=f"wm-{i:03d}", cpu_capacity=4000, ram_capacity=1 << 24,
+            task_slots=4,
+        ))
+    for i in range(500):
+        state.task_submitted(TaskInfo(
+            uid=task_uid("ckpt", i), job_id=f"j{i % 7}",
+            cpu_request=int(rng.integers(2, 12)) * 100,
+            ram_request=int(rng.integers(1, 16)) << 18,
+        ))
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+    _, m_cold = planner.schedule_round()
+    assert m_cold.unscheduled > 0  # a standing backlog exists
+    _, m_steady = planner.schedule_round()  # the steady-state warm cost
+
+    ckpt = tmp_path / "svc.ckpt"
+    save_checkpoint(state, planner, ckpt)
+    assert (tmp_path / "svc.ckpt.warm.npz").exists()
+
+    state2, planner2 = load_checkpoint(ckpt)
+    _, m_restored = planner2.schedule_round()
+    assert m_restored.converged
+    # The restored first round must behave like the steady-state round,
+    # not the cold one: identical backlog, frames restored.
+    assert m_restored.iterations <= max(2 * m_steady.iterations, 8), (
+        m_cold.iterations, m_steady.iterations, m_restored.iterations
+    )
+    assert m_restored.iterations < m_cold.iterations / 4
+
+    # Placements survive alongside: the same machines stay claimed.
+    placed1 = {t.uid: t.scheduled_to for t in state.tasks.values()
+               if t.scheduled_to}
+    placed2 = {t.uid: t.scheduled_to for t in state2.tasks.values()
+               if t.scheduled_to}
+    assert placed1.keys() == placed2.keys()
+
+
+def test_checkpoint_without_frames_degrades_to_cold(tmp_path):
+    from poseidon_tpu.graph.snapshot import load_checkpoint, save_checkpoint
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+    from poseidon_tpu.graph.state import ClusterState, MachineInfo
+
+    state = ClusterState()
+    state.node_added(MachineInfo(uuid="m-0", cpu_capacity=1000,
+                                 ram_capacity=1 << 20))
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+    ckpt = tmp_path / "empty.ckpt"
+    save_checkpoint(state, planner, ckpt)
+    # No frames were saved (nothing solved): loading must still work.
+    assert not (tmp_path / "empty.ckpt.warm.npz").exists()
+    state2, planner2 = load_checkpoint(ckpt)
+    assert not planner2._warm_bands
