@@ -32,7 +32,7 @@ log = logging.getLogger("poseidon_tpu.planner")
 
 from poseidon_tpu.costmodel.base import CostModel
 from poseidon_tpu.graph.state import ClusterState
-from poseidon_tpu.ops.transport import solve_transport
+from poseidon_tpu.ops.transport import INF_COST, solve_transport
 
 
 class DeltaType(enum.IntEnum):
@@ -617,34 +617,44 @@ class RoundPlanner:
             )
             cm = self.cost_model.build(ecs_b, mt_b)
 
-            # Resource-safe column capacity (min over dimensions).  Rows
-            # whose request exceeds every machine outright can never carry
-            # flow (per-arc fit already zeroes them), so they must not
-            # poison the band's max-request denominator.
+            # Resource-safe column capacity (min over dimensions), with a
+            # PER-COLUMN denominator: the largest request among rows
+            # actually admissible on that column (selectors + fit, read
+            # off the cost model's INF mask).  Sound — every unit a
+            # feasible flow puts on the column consumes at most that
+            # denominator, so units <= free // denom keeps the column
+            # within capacity — and strictly tighter than the band-global
+            # max, which strands small machines whenever a large task
+            # exists ANYWHERE in the band (a selector-pinned 2.8-core
+            # task on a 4-core node was starved by an 11.2-core task
+            # bound elsewhere: the reference e2e resource-limits
+            # predicate, poseidon_integration.go:294-407).
+            adm = cm.costs < INF_COST                      # [E_b, M]
             col_cap = cm.capacity.astype(np.int64)
             for req, cap_arr, used in (
                 (ecs_b.cpu_request, mt.cpu_capacity, committed_cpu),
                 (ecs_b.ram_request, mt.ram_capacity, committed_ram),
             ):
-                placeable = req <= int(cap_arr.max(initial=0))
-                mx = int(req[placeable].max(initial=0))
-                if mx > 0:
-                    free = np.maximum(
-                        cap_arr.astype(np.int64) - used, 0
-                    )
-                    col_cap = np.minimum(col_cap, free // mx)
+                denom = np.where(adm, req.astype(np.int64)[:, None], 0)
+                denom = denom.max(axis=0)                   # [M]
+                free = np.maximum(cap_arr.astype(np.int64) - used, 0)
+                col_cap = np.where(
+                    denom > 0, np.minimum(col_cap, free // np.maximum(
+                        denom, 1
+                    )), col_cap,
+                )
             net_req = ecs_b.net_rx()
             if mt.net_rx_capacity is not None:
                 raw = mt.net_rx_capacity.astype(np.int64)
-                placeable = net_req <= int(raw.max(initial=0))
-                mx_net = int(net_req[placeable].max(initial=0))
-                if mx_net > 0:
-                    free = np.maximum(raw - committed_net, 0)
-                    col_cap = np.where(
-                        raw > 0,
-                        np.minimum(col_cap, free // mx_net),
-                        col_cap,
-                    )
+                denom = np.where(
+                    adm, net_req.astype(np.int64)[:, None], 0
+                ).max(axis=0)
+                free = np.maximum(raw - committed_net, 0)
+                col_cap = np.where(
+                    (raw > 0) & (denom > 0),
+                    np.minimum(col_cap, free // np.maximum(denom, 1)),
+                    col_cap,
+                )
             col_cap = np.clip(col_cap, 0, None).astype(np.int32)
 
             sol = self._solve_band(band, ecs_b, cm, col_cap, mt.uuids)
@@ -770,8 +780,6 @@ class RoundPlanner:
         row, so loops over this step terminate within ``gangs.sum()``
         passes.
         """
-        from poseidon_tpu.ops.transport import INF_COST
-
         placed = sol.flows.sum(axis=1)
         partial = gangs & (placed > 0) & (placed < supply)
         if not partial.any():

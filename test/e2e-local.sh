@@ -36,6 +36,50 @@ kubectl rollout status deploy/nginx-poseidon --timeout=300s
 echo "e2e: nginx-poseidon pods scheduled by poseidon:"
 kubectl get pods -l app=nginx -o wide
 
+# Scheduler-behavior predicates (the reference's
+# test/e2e/poseidon_integration.go:409-478 nodeSelector pair; the full
+# predicate set incl. 70%-fill runs in-process in tests/test_e2e_predicates.py).
+
+# 1. NodeSelector NOT matching: must stay Pending.
+kubectl delete pod restricted-pod --ignore-not-found
+cat <<'POD' | kubectl apply -f -
+apiVersion: v1
+kind: Pod
+metadata: {name: restricted-pod, labels: {name: restricted}}
+spec:
+  schedulerName: poseidon
+  nodeSelector: {label: nonempty}
+  containers:
+  - name: pause
+    image: registry.k8s.io/pause:3.9
+POD
+sleep 30
+phase="$(kubectl get pod restricted-pod -o jsonpath='{.status.phase}')"
+[ "$phase" = "Pending" ] || { echo "FAIL: restricted-pod phase=$phase (want Pending)"; exit 1; }
+echo "e2e: non-matching nodeSelector stayed Pending"
+
+# 2. NodeSelector matching: label a worker node, pod must land on it.
+# (grep may match nothing on a single-node kind cluster — don't let
+# pipefail kill the script; fall back to the control-plane node.)
+node="$(kubectl get nodes -o name | { grep -v control-plane || true; } | head -1 | cut -d/ -f2)"
+node="${node:-$(kubectl get nodes -o jsonpath='{.items[0].metadata.name}')}"
+kubectl label node "$node" poseidon-e2e=42 --overwrite
+kubectl delete pod with-labels --ignore-not-found
+cat <<POD | kubectl apply -f -
+apiVersion: v1
+kind: Pod
+metadata: {name: with-labels}
+spec:
+  schedulerName: poseidon
+  nodeSelector: {poseidon-e2e: "42"}
+  containers:
+  - name: pause
+    image: registry.k8s.io/pause:3.9
+POD
+kubectl wait pod/with-labels --for=jsonpath='{.spec.nodeName}'="$node" --timeout=120s
+echo "e2e: matching nodeSelector landed on $node"
+kubectl delete pod restricted-pod with-labels --ignore-not-found
+
 # Throughput fixture (optional, big): uncomment to run the 1000-pod job.
 # kubectl apply -f deploy/configs/cpu_spin_1000_pods.yaml
 

@@ -1,0 +1,163 @@
+"""Scheduler-BEHAVIOR predicates, ported from the reference e2e suite
+(reference test/e2e/poseidon_integration.go), run fully in-process:
+FakeKube feeds the watchers, the real gRPC firmament-tpu service
+schedules, the glue loop enacts deltas back into the fake cluster.
+
+Ported predicates:
+- resource limits: fill every node to 70% CPU, then an oversized pod
+  must stay Pending (poseidon_integration.go:294-407);
+- NodeSelector not matching: stays Pending (:409-440);
+- NodeSelector matching: schedules onto exactly the labeled node
+  (:442-478);
+- Job / ReplicaSet lifecycles: owner-grouped pods all run, complete /
+  get replaced, and clean up (:60-292).
+"""
+
+import pytest
+
+from poseidon_tpu.glue import FakeKube, Node, Pod, Poseidon
+from poseidon_tpu.service import FirmamentTPUServer
+from poseidon_tpu.utils.config import PoseidonConfig
+
+
+@pytest.fixture()
+def system():
+    with FirmamentTPUServer(address="127.0.0.1:0") as server:
+        kube = FakeKube()
+        cfg = PoseidonConfig(
+            firmament_address=server.address, scheduling_interval=3600
+        )
+        poseidon = Poseidon(
+            kube, config=cfg, stats_address="127.0.0.1:0", run_loop=False
+        ).start(health_timeout=10)
+        try:
+            yield kube, poseidon, server
+        finally:
+            poseidon.stop()
+
+
+def _round(kube, poseidon):
+    assert poseidon.drain_watchers()
+    return poseidon.schedule_once()
+
+
+def test_resource_limits_oversized_pod_stays_pending(system):
+    """poseidon_integration.go:294-407: one filler pod per node at 70% of
+    that node's CPU all run; an additional pod needing 50% of the largest
+    node's CPU must stay Pending (30% is free everywhere)."""
+    kube, poseidon, _ = system
+    capacities = {"n1": 4000, "n2": 8000, "n3": 16000}
+    for name, cpu in capacities.items():
+        kube.add_node(Node(name=name, cpu_capacity=cpu,
+                           ram_capacity=1 << 24))
+    # Fillers pin to their node via a unique label selector, exactly how
+    # the reference directs one filler at each node.
+    for i, (name, cpu) in enumerate(capacities.items()):
+        kube.update_node(name, lambda n, i=i: n.labels.update(
+            {"fill": f"slot{i}"}
+        ))
+        kube.create_pod(Pod(
+            name=f"filler-{i}", cpu_request=cpu * 7 // 10,
+            ram_request=1 << 18, node_selector={"fill": f"slot{i}"},
+        ))
+    _round(kube, poseidon)
+    fillers = {f"default/filler-{i}" for i in range(3)}
+    for key in fillers:
+        assert kube.pods[key].phase == "Running", key
+    bound = dict(kube.bindings)
+    for i, name in enumerate(capacities):
+        assert bound[f"default/filler-{i}"] == name
+
+    # 50% of the largest node: no node has that much CPU left.
+    kube.create_pod(Pod(name="additional-pod",
+                        cpu_request=max(capacities.values()) * 5 // 10,
+                        ram_request=1 << 18))
+    for _ in range(3):  # several rounds: it must KEEP not scheduling
+        _round(kube, poseidon)
+        assert kube.pods["default/additional-pod"].phase == "Pending"
+    assert "default/additional-pod" not in dict(kube.bindings)
+
+
+def test_node_selector_not_matching_stays_pending(system):
+    """poseidon_integration.go:409-440: nodes carry no matching label, so
+    a nonempty NodeSelector must never schedule."""
+    kube, poseidon, _ = system
+    kube.add_node(Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.create_pod(Pod(name="restricted-pod", cpu_request=100,
+                        ram_request=1 << 18,
+                        node_selector={"label": "nonempty"}))
+    for _ in range(3):
+        _round(kube, poseidon)
+        assert kube.pods["default/restricted-pod"].phase == "Pending"
+
+
+def test_node_selector_matching_schedules_on_labeled_node(system):
+    """poseidon_integration.go:442-478: label one node, the selector pod
+    lands on exactly that node."""
+    kube, poseidon, _ = system
+    for name in ("n1", "n2", "n3"):
+        kube.add_node(Node(name=name, cpu_capacity=4000,
+                           ram_capacity=1 << 24))
+    kube.update_node("n2", lambda n: n.labels.update(
+        {"kubernetes.io/e2e-42": "42"}
+    ))
+    kube.create_pod(Pod(name="with-labels", cpu_request=100,
+                        ram_request=1 << 18,
+                        node_selector={"kubernetes.io/e2e-42": "42"}))
+    _round(kube, poseidon)
+    assert kube.pods["default/with-labels"].phase == "Running"
+    assert dict(kube.bindings)["default/with-labels"] == "n2"
+
+
+def test_job_lifecycle_runs_and_completes(system):
+    """poseidon_integration.go:171-292 (Job): owner-grouped pods all get
+    placed, report completion, and deletion cleans up state — the
+    service answers the full TaskSubmitted/Completed/Removed sequence."""
+    kube, poseidon, server = system
+    for i in range(2):
+        kube.add_node(Node(name=f"n{i}", cpu_capacity=8000,
+                           ram_capacity=1 << 24))
+    for i in range(4):
+        kube.create_pod(Pod(name=f"job-pod-{i}", owner_uid="job-77",
+                            cpu_request=500, ram_request=1 << 18))
+    _round(kube, poseidon)
+    for i in range(4):
+        assert kube.pods[f"default/job-pod-{i}"].phase == "Running"
+    # All four tasks belong to ONE service-side job (owner grouping).
+    assert len({t.job_id for t in server.servicer.state.tasks.values()}) == 1
+
+    # Completion: pods Succeed, the watcher reports TaskCompleted, and a
+    # follow-up round has nothing to do.
+    for i in range(4):
+        kube.set_pod_phase(f"default/job-pod-{i}", "Succeeded")
+    deltas = _round(kube, poseidon)
+    assert deltas == []
+    # Deletion cleans the service state (job GC'd with its tasks).
+    for i in range(4):
+        kube.delete_pod("default", f"job-pod-{i}")
+    _round(kube, poseidon)
+    assert not server.servicer.state.tasks
+
+
+def test_replicaset_lifecycle_replacement_pod_reschedules(system):
+    """poseidon_integration.go:110-169 (ReplicaSet): N replicas run;
+    when one dies the controller's replacement pod (same owner) is
+    scheduled in the next round."""
+    kube, poseidon, _ = system
+    for i in range(2):
+        kube.add_node(Node(name=f"n{i}", cpu_capacity=8000,
+                           ram_capacity=1 << 24))
+    for i in range(3):
+        kube.create_pod(Pod(name=f"rs-pod-{i}", owner_uid="rs-5",
+                            cpu_request=500, ram_request=1 << 18))
+    _round(kube, poseidon)
+    assert all(kube.pods[f"default/rs-pod-{i}"].phase == "Running"
+               for i in range(3))
+
+    # One replica fails; the controller resubmits a replacement.
+    kube.set_pod_phase("default/rs-pod-1", "Failed")
+    kube.delete_pod("default", "rs-pod-1")
+    kube.create_pod(Pod(name="rs-pod-1-repl", owner_uid="rs-5",
+                        cpu_request=500, ram_request=1 << 18))
+    _round(kube, poseidon)
+    assert kube.pods["default/rs-pod-1-repl"].phase == "Running"

@@ -1,0 +1,208 @@
+"""Direct unit tests for the real-cluster adapter (glue/kube_client.py)
+with a STUBBED ``kubernetes`` package — no cluster, no dependency.
+
+This is the only code path to a real cluster (watch streams, the
+pods/binding subresource, pod deletion), the surface the reference
+unit-tests against its fake clientset (reference
+pkg/k8sclient/nodewatcher_test.go:120-216).  The stub module is injected
+into sys.modules before import and removed after, so the rest of the
+suite keeps seeing the dependency as absent.
+"""
+
+from __future__ import annotations
+
+import importlib
+import queue
+import sys
+import threading
+import types
+from types import SimpleNamespace as NS
+
+import pytest
+
+
+def _v1_pod(name, phase="Pending", node=""):
+    return NS(
+        metadata=NS(name=name, namespace="default", owner_references=None,
+                    labels={"app": name}, deletion_timestamp=None),
+        spec=NS(containers=[NS(resources=NS(requests={"cpu": "100m",
+                                                      "memory": "64Mi"}))],
+                scheduler_name="poseidon", node_name=node,
+                node_selector=None, affinity=None),
+        status=NS(phase=phase),
+    )
+
+
+def _v1_node(name, ready="True"):
+    return NS(
+        metadata=NS(name=name, labels={}),
+        spec=NS(unschedulable=False),
+        status=NS(capacity={"cpu": "4", "memory": "8Gi"},
+                  conditions=[NS(type="Ready", status=ready)]),
+    )
+
+
+class _FakeWatch:
+    """Scripted Watch: each stream() call pops the next behavior —
+    a list of events to yield, or an Exception to raise (the resync
+    path informers take on watch errors)."""
+
+    script: list = []
+
+    def stream(self, list_fn, timeout_seconds=None):
+        if not _FakeWatch.script:
+            # Idle stream: end immediately (the loop re-enters until
+            # stopped, exactly like a timed-out K8s watch).
+            return iter(())
+        step = _FakeWatch.script.pop(0)
+        if isinstance(step, Exception):
+            raise step
+        return iter(step)
+
+
+@pytest.fixture()
+def kube_stub(monkeypatch):
+    """Install a minimal fake `kubernetes` package and import the
+    adapter against it; undo both afterwards."""
+    calls = {"bindings": [], "deletes": [], "config": []}
+
+    class _CoreV1Api:
+        def list_pod_for_all_namespaces(self):
+            return NS(items=[_v1_pod("p0")])
+
+        def list_node(self):
+            return NS(items=[_v1_node("n0")])
+
+        def create_namespaced_pod_binding(self, name, namespace, body,
+                                          _preload_content=True):
+            calls["bindings"].append((namespace, name, body,
+                                      _preload_content))
+
+        def delete_namespaced_pod(self, name, namespace):
+            calls["deletes"].append((namespace, name))
+
+    class _V1Binding:
+        def __init__(self, metadata=None, target=None):
+            self.metadata = metadata
+            self.target = target
+
+    kubernetes = types.ModuleType("kubernetes")
+    kubernetes.client = types.ModuleType("kubernetes.client")
+    kubernetes.client.CoreV1Api = _CoreV1Api
+    kubernetes.client.V1Binding = _V1Binding
+    kubernetes.client.V1ObjectMeta = lambda **kw: NS(**kw)
+    kubernetes.client.V1ObjectReference = lambda **kw: NS(**kw)
+    kubernetes.config = types.ModuleType("kubernetes.config")
+    kubernetes.config.load_kube_config = (
+        lambda config_file=None: calls["config"].append(
+            ("kubeconfig", config_file)
+        )
+    )
+    kubernetes.config.load_incluster_config = (
+        lambda: calls["config"].append(("incluster", None))
+    )
+    kubernetes.watch = types.ModuleType("kubernetes.watch")
+    kubernetes.watch.Watch = _FakeWatch
+
+    for mod in ("kubernetes", "kubernetes.client", "kubernetes.config",
+                "kubernetes.watch"):
+        monkeypatch.setitem(sys.modules, mod, getattr(
+            kubernetes, mod.split(".", 1)[1]
+        ) if "." in mod else kubernetes)
+    sys.modules.pop("poseidon_tpu.glue.kube_client", None)
+    mod = importlib.import_module("poseidon_tpu.glue.kube_client")
+    _FakeWatch.script = []
+    yield mod, calls
+    sys.modules.pop("poseidon_tpu.glue.kube_client", None)
+
+
+def test_config_selection(kube_stub):
+    mod, calls = kube_stub
+    mod.RealKube(kubeconfig="/tmp/kc.yaml")
+    assert calls["config"][-1] == ("kubeconfig", "/tmp/kc.yaml")
+    mod.RealKube()
+    assert calls["config"][-1] == ("incluster", None)
+
+
+def test_config_incluster_fallback_to_kubeconfig(kube_stub, monkeypatch):
+    """Outside a cluster, in-cluster config raises and the adapter falls
+    back to the default kubeconfig (k8sclient.go:57-62 semantics)."""
+    mod, calls = kube_stub
+
+    def boom():
+        raise RuntimeError("not in cluster")
+
+    monkeypatch.setattr(
+        sys.modules["kubernetes.config"], "load_incluster_config", boom
+    )
+    mod.RealKube()
+    assert calls["config"][-1] == ("kubeconfig", None)
+
+
+def test_list_conversion(kube_stub):
+    mod, _ = kube_stub
+    k = mod.RealKube()
+    pods = k.list_pods()
+    assert pods[0].name == "p0" and pods[0].cpu_request == 100
+    assert pods[0].ram_request == 64 << 10
+    nodes = k.list_nodes()
+    assert nodes[0].name == "n0" and nodes[0].cpu_capacity == 4000
+
+
+def test_watch_event_mapping_and_error_resync(kube_stub):
+    """Watch events map type+object onto the seam's Event tuples, and a
+    stream error resyncs (next stream call) instead of killing the
+    watcher thread — informer semantics (kube_client._watch_loop)."""
+    mod, _ = kube_stub
+    k = mod.RealKube()
+    _FakeWatch.script = [
+        [{"type": "ADDED", "object": _v1_pod("a")}],
+        RuntimeError("watch expired"),          # must resync, not die
+        [{"type": "MODIFIED", "object": _v1_pod("a", phase="Running",
+                                                node="n0")},
+         {"type": "DELETED", "object": _v1_pod("a")}],
+    ]
+    q = k.watch_pods()
+    try:
+        ev1 = q.get(timeout=10)
+        ev2 = q.get(timeout=10)
+        ev3 = q.get(timeout=10)
+    finally:
+        k.stop()
+    assert ev1[0] == "ADDED" and ev1[1].name == "a"
+    assert ev2[0] == "MODIFIED" and ev2[1].node_name == "n0"
+    assert ev3[0] == "DELETED"
+
+
+def test_watch_stop_terminates_thread(kube_stub):
+    mod, _ = kube_stub
+    k = mod.RealKube()
+    q = k.watch_nodes()
+    assert isinstance(q, queue.Queue)
+    k.stop()
+    deadline = threading.Event()
+    # The loop re-checks _stop between (empty) streams; give it a moment.
+    deadline.wait(0.2)
+    before = threading.active_count()
+    deadline.wait(0.3)
+    assert threading.active_count() <= before
+
+
+def test_bind_pod_posts_binding_subresource(kube_stub):
+    """POST pods/{name}/binding with a Node target and _preload_content
+    off (the reply is not a typed object) — k8sclient.go:33-46."""
+    mod, calls = kube_stub
+    k = mod.RealKube()
+    k.bind_pod("ns1", "pod-a", "node-7")
+    (namespace, name, body, preload) = calls["bindings"][0]
+    assert (namespace, name) == ("ns1", "pod-a")
+    assert body.target.kind == "Node" and body.target.name == "node-7"
+    assert body.metadata.name == "pod-a"
+    assert preload is False
+
+
+def test_delete_pod(kube_stub):
+    mod, calls = kube_stub
+    k = mod.RealKube()
+    k.delete_pod("ns2", "pod-b")
+    assert calls["deletes"] == [("ns2", "pod-b")]
