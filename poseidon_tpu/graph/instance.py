@@ -267,6 +267,10 @@ class RoundPlanner:
         self.incremental = incremental
         # Warm-start frames, one per size band (see _solve_banded).
         self._warm_bands: Dict[int, _WarmState] = {}
+        # Per-round resubmission-affinity hint: per-EC arrays of prior
+        # machine COLUMNS for pending members (consumed from
+        # state.prior_machine each round; None when nothing matched).
+        self._round_prior: Optional[List[np.ndarray]] = None
         self._last_generation = -1
         self._last_unscheduled = 1  # force a solve on the first round
         self.last_metrics = RoundMetrics()
@@ -505,6 +509,7 @@ class RoundPlanner:
             return [], metrics
 
         metrics.num_ecs = ecs.num_ecs
+        self._collect_prior(view, mt)
 
         t_solve = time.perf_counter()
         from poseidon_tpu.ops.transport import device_call_count
@@ -538,6 +543,52 @@ class RoundPlanner:
         metrics.total_seconds = time.perf_counter() - t0
         self.last_metrics = metrics
         return deltas, metrics
+
+    def _collect_prior(self, view, mt) -> None:
+        """Resubmission affinity: map each pending member's PRIOR machine
+        (recorded by ClusterState.task_removed) to this round's machine
+        column, for the ASSIGNMENT pass only — a resubmitted task whose
+        prior machine still receives flow goes back there (image/data
+        locality), at zero solver cost.  (Seeding the SOLVE from prior
+        placements was measured net-harmful: load-shaped costs move
+        between rounds, so the prior assignment certifies worse than a
+        fresh greedy — 217-300 iterations vs 0 at 1k/10k churn.)
+        Entries are consumed (popped) — a one-shot hint, so the dict
+        cannot pin dead uids."""
+        self._round_prior = None
+        prior = self.state.prior_machine
+        if not (self.incremental and prior):
+            return
+        col_of = {u: j for j, u in enumerate(mt.uuids)}
+        per_ec: List[np.ndarray] = []
+        found = 0
+        # Mutating the state's hint dict follows the class's locking
+        # discipline (task_removed writes it under the same lock).
+        with self.state._lock:
+            keys = np.fromiter(
+                prior.keys(), dtype=np.uint64, count=len(prior)
+            )
+            for i in range(view.ecs.num_ecs):
+                uids = view.member_uids[i]
+                cur = view.member_cur[i]
+                cols = np.full(uids.size, -1, dtype=np.int64)
+                cand = np.nonzero(cur < 0)[0]  # pending members only
+                if cand.size > 64:
+                    # Vectorized prefilter: the Python pop loop below
+                    # must touch only actual hits, not a whole wave of
+                    # fresh uids (the hint dict can hold a megabyte of
+                    # dead entries a wave never matches).
+                    cand = cand[np.isin(uids[cand], keys)]
+                for j in cand.tolist():
+                    m = prior.pop(int(uids[j]), None)
+                    if m is not None:
+                        c = col_of.get(m, -1)
+                        cols[j] = c
+                        if c >= 0:
+                            found += 1
+                per_ec.append(cols)
+        if found:
+            self._round_prior = per_ec
 
     # Size-band ladder: rows whose dominant resource fraction falls within
     # one factor-of-BAND_BASE band solve together; bands go largest-first.
@@ -939,14 +990,30 @@ class RoundPlanner:
                 rem = want
 
             # Pass 2: longest-waiting first; ties by uid (members are
-            # uid-sorted, so index order is uid order).
+            # uid-sorted, so index order is uid order).  Resubmission
+            # affinity is a TIE-BREAK within the members this pass
+            # would place anyway: WHO places is still wait-ordered (the
+            # starvation escalator's bounded-unfairness guarantee must
+            # not lose to a wait=0 resubmission), only WHERE adjusts —
+            # a chosen member whose prior machine still has flow goes
+            # back there (image/data locality); the flow itself is the
+            # fresh solve's, best-effort only.
             pool = np.nonzero(new_col < 0)[0]
             if pool.size:
                 pool = pool[np.lexsort((pool, -wait[pool]))]
+                chosen = pool[: min(pool.size, int(rem.sum()))]
+                if self._round_prior is not None and chosen.size:
+                    pcols = self._round_prior[i]
+                    for j in chosen.tolist():
+                        c = int(pcols[j])
+                        if c >= 0 and rem[c] > 0:
+                            new_col[j] = c
+                            rem[c] -= 1
+                    chosen = chosen[new_col[chosen] < 0]
                 cols_exp = np.repeat(np.arange(M, dtype=np.int64), rem)
-                k = min(pool.size, cols_exp.size)
+                k = min(chosen.size, cols_exp.size)
                 if k:
-                    new_col[pool[:k]] = cols_exp[:k]
+                    new_col[chosen[:k]] = cols_exp[:k]
 
             # Pass 3: diff -> deltas; only changed tasks touch Python.
             if not self.preemption:

@@ -227,6 +227,15 @@ class ClusterState:
         # Live count of tasks carrying pod-level (anti-)affinity: the
         # per-round resident-label pass is skipped entirely when zero.
         self._pod_selector_tasks = 0
+        # Resubmission affinity: machine a REMOVED task was running on,
+        # keyed by uid.  Steady-state churn removes and resubmits the
+        # same work (reference controllers recreate pods; the bench's 1%
+        # churn resubmits identical uids); seeding the solver from these
+        # placements turns the churn round into a near-no-op instead of
+        # a few hundred redistribution iterations.  Bounded FIFO
+        # (insertion order) so dead uids cannot grow it without limit.
+        self.prior_machine: Dict[int, str] = {}
+        self._PRIOR_CAP = 1_000_000
 
     def _nkey(self, uuid: str) -> int:
         """Native machine key for a uuid (minted once; never 0)."""
@@ -323,6 +332,13 @@ class ClusterState:
             task = self.tasks.pop(uid, None)
             if task is None:
                 return TaskReply.NOT_FOUND
+            if task.scheduled_to is not None:
+                self.prior_machine.pop(uid, None)  # refresh FIFO position
+                self.prior_machine[uid] = task.scheduled_to
+                while len(self.prior_machine) > self._PRIOR_CAP:
+                    self.prior_machine.pop(
+                        next(iter(self.prior_machine))
+                    )
             if task.pod_affinity or task.pod_anti_affinity:
                 self._pod_selector_tasks -= 1
             if self._native is not None:

@@ -180,3 +180,100 @@ def test_precompile_covers_round_shapes():
     _, metrics = planner.schedule_round()
     assert metrics.placed > 0
     assert _solve_device._cache_size() == before
+
+
+def test_resubmission_affinity_returns_tasks_to_prior_machines():
+    """A removed-and-resubmitted task goes back to the machine it ran on
+    whenever the solver's flow still covers it (assignment-level
+    affinity: image/data locality at zero solver cost).  Solver seeding
+    from priors was measured net-harmful and is intentionally absent
+    (docs/PERF.md round-4 negative results)."""
+    import numpy as np
+
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+    from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+    from poseidon_tpu.utils.ids import task_uid
+
+    state = ClusterState()
+    # Uniformly loaded at steady state (5 slots x 8 machines = exactly
+    # the workload): vacated slots are then the only free capacity, the
+    # cost-optimal flow returns to them, and the affinity pass decides
+    # WHICH member takes which vacated slot — its own.
+    for i in range(8):
+        state.node_added(MachineInfo(
+            uuid=f"ra-m{i}", cpu_capacity=8000, ram_capacity=1 << 24,
+            task_slots=5,
+        ))
+    for i in range(40):
+        state.task_submitted(TaskInfo(
+            uid=task_uid("ra", i), job_id=f"j{i % 4}",
+            cpu_request=500, ram_request=1 << 18,
+        ))
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+    planner.schedule_round()
+    placed = {u: t.scheduled_to for u, t in state.tasks.items()}
+    assert all(placed.values())
+
+    # Churn 25%: remove + resubmit identical tasks.
+    rng = np.random.default_rng(0)
+    churned = [list(placed)[k] for k in
+               rng.choice(len(placed), size=10, replace=False)]
+    for uid in churned:
+        t = state.tasks[uid]
+        state.task_removed(uid)
+        assert state.prior_machine[uid] == placed[uid]
+        state.task_submitted(TaskInfo(
+            uid=uid, job_id=t.job_id, cpu_request=t.cpu_request,
+            ram_request=t.ram_request,
+        ))
+    _, m = planner.schedule_round()
+    assert m.converged and m.placed == 10
+    back = sum(
+        1 for uid in churned if state.tasks[uid].scheduled_to == placed[uid]
+    )
+    # All capacity they vacated is still free, so everyone goes home.
+    assert back == 10, back
+    # Consumed: the hint dict does not accumulate.
+    assert not any(uid in state.prior_machine for uid in churned)
+
+
+def test_affinity_never_starves_longest_waiter():
+    """Affinity is a WHERE tie-break, not a WHO override: when an EC has
+    more pending members than flow, the longest-waiting member places
+    first even if a freshly resubmitted member carries a prior-machine
+    hint (the starvation escalator's bounded-unfairness guarantee)."""
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+    from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+    from poseidon_tpu.utils.ids import task_uid
+
+    state = ClusterState()
+    # One slot total: exactly one member can place per round.
+    state.node_added(MachineInfo(
+        uuid="st-m0", cpu_capacity=8000, ram_capacity=1 << 24, task_slots=1,
+    ))
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+
+    # Occupant runs; a waiter accumulates wait rounds behind it.
+    state.task_submitted(TaskInfo(uid=task_uid("st", 0), job_id="j",
+                                  cpu_request=100, ram_request=1 << 18))
+    planner.schedule_round()
+    waiter = task_uid("st", 1)
+    state.task_submitted(TaskInfo(uid=waiter, job_id="j",
+                                  cpu_request=100, ram_request=1 << 18))
+    for _ in range(3):
+        _, m = planner.schedule_round()
+        assert state.tasks[waiter].scheduled_to is None  # still waiting
+    assert state.tasks[waiter].wait_rounds >= 2
+
+    # The occupant churns: removed (recording its prior machine) and
+    # resubmitted with wait 0.  The freed slot must go to the WAITER,
+    # not back to the resubmission via its affinity hint.
+    occ = task_uid("st", 0)
+    state.task_removed(occ)
+    state.task_submitted(TaskInfo(uid=occ, job_id="j",
+                                  cpu_request=100, ram_request=1 << 18))
+    _, m = planner.schedule_round()
+    assert state.tasks[waiter].scheduled_to == "st-m0"
+    assert state.tasks[occ].scheduled_to is None
