@@ -565,19 +565,25 @@ class RoundPlanner:
         # Mutating the state's hint dict follows the class's locking
         # discipline (task_removed writes it under the same lock).
         with self.state._lock:
-            keys = np.fromiter(
-                prior.keys(), dtype=np.uint64, count=len(prior)
-            )
+            keys = None  # built lazily: only the big-EC prefilter needs it
             for i in range(view.ecs.num_ecs):
                 uids = view.member_uids[i]
                 cur = view.member_cur[i]
                 cols = np.full(uids.size, -1, dtype=np.int64)
+                per_ec.append(cols)
+                if not prior:
+                    continue  # drained: remaining ECs cannot match
                 cand = np.nonzero(cur < 0)[0]  # pending members only
                 if cand.size > 64:
                     # Vectorized prefilter: the Python pop loop below
                     # must touch only actual hits, not a whole wave of
                     # fresh uids (the hint dict can hold a megabyte of
                     # dead entries a wave never matches).
+                    if keys is None:
+                        keys = np.fromiter(
+                            prior.keys(), dtype=np.uint64,
+                            count=len(prior),
+                        )
                     cand = cand[np.isin(uids[cand], keys)]
                 for j in cand.tolist():
                     m = prior.pop(int(uids[j]), None)
@@ -586,7 +592,6 @@ class RoundPlanner:
                         cols[j] = c
                         if c >= 0:
                             found += 1
-                per_ec.append(cols)
         if found:
             self._round_prior = per_ec
 

@@ -1,0 +1,99 @@
+"""A/B the fused Pallas ladder kernel against the lax path on the live
+backend: correctness (bit-parity) first, then wall-clock at the churn-
+and selective-representative shapes the fused kernel targets.
+
+Usage (serialize against other chip users; never external-kill this):
+    python tools/bench_fused.py [--reps 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_instance(E, M, seed, contended):
+    from poseidon_tpu.ops.transport import INF_COST
+
+    rng = np.random.default_rng(seed)
+    costs = rng.integers(0, 1000, size=(E, M)).astype(np.int32)
+    costs[rng.random((E, M)) < 0.05] = INF_COST
+    supply = rng.integers(2, 12, size=E).astype(np.int32)
+    if contended:
+        capacity = np.zeros(M, np.int32)
+        free = rng.choice(M, size=max(M // 2, 1), replace=False)
+        capacity[free] = rng.integers(1, 4, size=free.size)
+    else:
+        capacity = rng.integers(1, 12, size=M).astype(np.int32)
+    unsched = rng.integers(1000, 2000, size=E).astype(np.int32)
+    return costs, supply, capacity, unsched
+
+
+def run(mode, inst, reps):
+    os.environ["POSEIDON_FUSED"] = mode
+    from poseidon_tpu.ops.transport import solve_transport
+
+    costs, supply, capacity, unsched = inst
+    sol = solve_transport(costs, supply, capacity, unsched)  # compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        sol = solve_transport(costs, supply, capacity, unsched)
+    return (time.perf_counter() - t0) / reps, sol
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    from poseidon_tpu.utils.envutil import (
+        probe_device_count,
+        serialize_device_access,
+    )
+
+    if not serialize_device_access():
+        print("device lock busy; aborting", flush=True)
+        raise SystemExit(2)
+    if probe_device_count(timeout=300.0) < 0:
+        print("backend unreachable; aborting", flush=True)
+        raise SystemExit(2)
+
+    import jax
+
+    print(f"backend: {jax.devices()[0].platform}", flush=True)
+    shapes = [
+        (64, 512, False),    # small churn
+        (128, 1024, True),   # selective width, contended
+        (128, 2048, True),   # VMEM-budget edge
+    ]
+    if os.environ.get("POSEIDON_BENCH_FUSED_SMOKE"):
+        # CPU smoke: interpret-mode Pallas is an emulator — keep it tiny.
+        shapes = [(16, 128, False)]
+    for E, M, cont in shapes:
+        inst = make_instance(E, M, seed=7, contended=cont)
+        t_lax, s_lax = run("0", inst, args.reps)
+        t_fused, s_fused = run("1", inst, args.reps)
+        ok = (
+            s_lax.objective == s_fused.objective
+            and s_lax.iterations == s_fused.iterations
+            and np.array_equal(s_lax.flows, s_fused.flows)
+            and np.array_equal(s_lax.prices, s_fused.prices)
+        )
+        print(
+            f"[{E}x{M}{' cont' if cont else ''}] lax {t_lax * 1000:.1f}ms "
+            f"fused {t_fused * 1000:.1f}ms speedup {t_lax / t_fused:.2f}x "
+            f"iters={s_lax.iterations} bit-parity={'OK' if ok else 'FAIL'}",
+            flush=True,
+        )
+        if not ok:
+            raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# One serialized TPU measurement session, to run when the tunnel is
+# alive.  Order matters: cheap validation first, the expensive ladder
+# last, everything through ONE process at a time (the flock in
+# envutil.serialize_device_access); never externally kill any step —
+# each step bounds itself internally.
+set -uo pipefail
+cd "$(dirname "${BASH_SOURCE[0]}")/.."
+mkdir -p out
+
+echo "=== 1. latency decomposition (tunnel dispatch / transfer / solve)"
+python tools/profile_solver.py --machines 1000 --ecs 100 2>&1 | tee out/tpu_profile_1k.txt
+
+echo "=== 2. fused-kernel Mosaic validation + A/B vs lax path"
+python tools/bench_fused.py 2>&1 | tee out/tpu_fused_ab.txt
+
+echo "=== 3. full bench ladder (tagged backend; partial lines salvage)"
+POSEIDON_BENCH_RUNG_TIMEOUT="${POSEIDON_BENCH_RUNG_TIMEOUT:-3000}" \
+python bench.py --verbose 2> >(tee out/tpu_bench_stderr.txt >&2) | tee out/tpu_bench.jsonl
+
+echo "=== done; last bench line:"
+tail -1 out/tpu_bench.jsonl
