@@ -609,6 +609,11 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
     return F, Ffb, prices, iters, bf, clean, phase_iters
 
 
+# Latched True after the first Mosaic-lowering failure of the fused
+# kernel on this process's backend (see solve_transport's fallback).
+_FUSED_BROKEN = False
+
+
 def _use_fused(e_pad: int, m_pad: int) -> bool:
     """Route this solve through the fused Pallas ladder kernel?
 
@@ -621,7 +626,7 @@ def _use_fused(e_pad: int, m_pad: int) -> bool:
     from poseidon_tpu.ops.transport_fused import fits_vmem
 
     env = os.environ.get("POSEIDON_FUSED", "")
-    if env == "0":
+    if env == "0" or _FUSED_BROKEN:
         return False
     if not fits_vmem(e_pad, m_pad):
         return False
@@ -1159,16 +1164,7 @@ def solve_transport(
     if max_iter_total is None:
         max_iter_total = NUM_PHASES * max_iter_per_phase
     _Telemetry.device_calls += 1
-    solve_fn = _solve_device
-    fused_kw = {}
-    if _use_fused(E_pad, M_pad):
-        from poseidon_tpu.ops.transport_fused import solve_device_fused
-
-        solve_fn = solve_device_fused
-        # Interpret mode on hosts without a Mosaic backend (tests / CPU
-        # fallback with POSEIDON_FUSED=1); compiled on the accelerator.
-        fused_kw = {"interpret": jax.default_backend() == "cpu"}
-    flows, unsched, prices, iters, bf, clean, phase_iters = solve_fn(
+    operands = (
         jnp.asarray(costs_p), jnp.asarray(supply_p), jnp.asarray(capacity_p),
         jnp.asarray(unsched_p), jnp.asarray(arc_p),
         jnp.asarray(prices_p),
@@ -1178,8 +1174,38 @@ def solve_transport(
         jnp.int32(max_iter_total),
         jnp.int32(global_update_every),
         jnp.int32(bf_max),
-        max_iter=max_iter_per_phase, scale=int(scale), **fused_kw,
     )
+    out = None
+    if _use_fused(E_pad, M_pad):
+        from poseidon_tpu.ops.transport_fused import solve_device_fused
+
+        try:
+            out = solve_device_fused(
+                *operands, max_iter=max_iter_per_phase, scale=int(scale),
+                # Interpret mode on hosts without a Mosaic backend
+                # (tests / CPU with POSEIDON_FUSED=1); compiled on TPU.
+                interpret=jax.default_backend() == "cpu",
+            )
+        except Exception as e:  # noqa: BLE001 - availability over speed
+            # A backend whose Mosaic lowering rejects the kernel must
+            # degrade to the (mathematically identical) lax path, not
+            # fail every small solve.  Once broken, stay off: the error
+            # is per-program, not per-instance.
+            global _FUSED_BROKEN
+            if not _FUSED_BROKEN:
+                _FUSED_BROKEN = True
+                import logging
+
+                logging.getLogger("poseidon_tpu.transport").error(
+                    "fused Pallas kernel unavailable on this backend "
+                    "(%s: %s); using the lax path",
+                    type(e).__name__, e,
+                )
+    if out is None:
+        out = _solve_device(
+            *operands, max_iter=max_iter_per_phase, scale=int(scale)
+        )
+    flows, unsched, prices, iters, bf, clean, phase_iters = out
     flows = np.asarray(flows)[:E, :M]
     unsched = np.asarray(unsched)[:E]
     prices_full = np.asarray(prices)

@@ -111,3 +111,24 @@ def test_fits_vmem_gate():
     assert fits_vmem(256, 1024)
     assert fits_vmem(128, 2048)
     assert not fits_vmem(256, 10240)  # the 10k full-wave width
+
+
+def test_fused_failure_degrades_to_lax(monkeypatch):
+    """A backend whose Mosaic lowering rejects the kernel must fall back
+    to the lax path (identical math) and latch off — never fail solves."""
+    import poseidon_tpu.ops.transport as T
+    import poseidon_tpu.ops.transport_fused as TF
+
+    def boom(*a, **k):
+        raise RuntimeError("mosaic lowering failed")
+
+    monkeypatch.setenv("POSEIDON_FUSED", "1")
+    monkeypatch.setattr(TF, "solve_device_fused", boom)
+    monkeypatch.setattr(T, "_FUSED_BROKEN", False)
+    costs, supply, cap, unsched, arc = _instance(12, 64, 3)
+    sol = solve_transport(costs, supply, cap, unsched, arc_capacity=arc)
+    assert sol.gap_bound == 0.0
+    assert T._FUSED_BROKEN  # latched: later solves skip the broken path
+    monkeypatch.setenv("POSEIDON_FUSED", "0")
+    ref = solve_transport(costs, supply, cap, unsched, arc_capacity=arc)
+    assert sol.objective == ref.objective

@@ -79,6 +79,15 @@ def main():
         inst = make_instance(E, M, seed=7, contended=cont)
         t_lax, s_lax = run("0", inst, args.reps)
         t_fused, s_fused = run("1", inst, args.reps)
+        from poseidon_tpu.ops import transport
+
+        if transport._FUSED_BROKEN:
+            # The whole point of this bench is Mosaic validation: a
+            # silently-latched lax fallback must FAIL it, not produce a
+            # 1.00x "pass" that never ran the kernel.
+            print("FAIL: fused kernel did not lower on this backend "
+                  "(fallback latched); see the log above", flush=True)
+            raise SystemExit(1)
         ok = (
             s_lax.objective == s_fused.objective
             and s_lax.iterations == s_fused.iterations
