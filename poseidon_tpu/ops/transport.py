@@ -609,9 +609,32 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
     return F, Ffb, prices, iters, bf, clean, phase_iters
 
 
-# Latched True after the first Mosaic-lowering failure of the fused
-# kernel on this process's backend (see solve_transport's fallback).
+# Latched True after the first Mosaic-lowering failure of the fused /
+# tiled kernels on this process's backend (see solve_transport's
+# fallback).
 _FUSED_BROKEN = False
+_TILED_BROKEN = False
+
+
+def _use_tiled(e_pad: int, m_pad: int) -> bool:
+    """Route this solve through the tiled per-iteration Pallas kernel?
+
+    The tier ABOVE the fused ladder kernel: instances too big for VMEM
+    residency (the 10k-machine full wave) but with few enough EC rows
+    that a column tile's working set fits (transport_tiled.fits_tile).
+    Same overrides as the fused gate (POSEIDON_TILED=1/0).
+    """
+    from poseidon_tpu.ops.transport_fused import fits_vmem
+    from poseidon_tpu.ops.transport_tiled import fits_tile
+
+    env = os.environ.get("POSEIDON_TILED", "")
+    if env == "0" or _TILED_BROKEN:
+        return False
+    if fits_vmem(e_pad, m_pad) or not fits_tile(e_pad):
+        return False
+    if env == "1":
+        return True
+    return jax.default_backend() in ("tpu", "axon")
 
 
 def _use_fused(e_pad: int, m_pad: int) -> bool:
@@ -1175,32 +1198,38 @@ def solve_transport(
         jnp.int32(global_update_every),
         jnp.int32(bf_max),
     )
+    def _try_pallas(solve_fn, kernel_name, latch_name):
+        # A backend whose Mosaic lowering rejects a kernel must degrade
+        # to the (mathematically identical) lax path, not fail solves.
+        # Once broken, stay off: the error is per-program, not
+        # per-instance.
+        try:
+            return solve_fn(
+                *operands, max_iter=max_iter_per_phase, scale=int(scale),
+                # Interpret mode on hosts without a Mosaic backend
+                # (tests / CPU with POSEIDON_FUSED/TILED=1); compiled on
+                # the accelerator.
+                interpret=jax.default_backend() == "cpu",
+            )
+        except Exception as e:  # noqa: BLE001 - availability over speed
+            globals()[latch_name] = True
+            import logging
+
+            logging.getLogger("poseidon_tpu.transport").error(
+                "%s Pallas kernel unavailable on this backend (%s: %s); "
+                "using the lax path", kernel_name, type(e).__name__, e,
+            )
+            return None
+
     out = None
     if _use_fused(E_pad, M_pad):
         from poseidon_tpu.ops.transport_fused import solve_device_fused
 
-        try:
-            out = solve_device_fused(
-                *operands, max_iter=max_iter_per_phase, scale=int(scale),
-                # Interpret mode on hosts without a Mosaic backend
-                # (tests / CPU with POSEIDON_FUSED=1); compiled on TPU.
-                interpret=jax.default_backend() == "cpu",
-            )
-        except Exception as e:  # noqa: BLE001 - availability over speed
-            # A backend whose Mosaic lowering rejects the kernel must
-            # degrade to the (mathematically identical) lax path, not
-            # fail every small solve.  Once broken, stay off: the error
-            # is per-program, not per-instance.
-            global _FUSED_BROKEN
-            if not _FUSED_BROKEN:
-                _FUSED_BROKEN = True
-                import logging
+        out = _try_pallas(solve_device_fused, "fused", "_FUSED_BROKEN")
+    elif _use_tiled(E_pad, M_pad):
+        from poseidon_tpu.ops.transport_tiled import solve_device_tiled
 
-                logging.getLogger("poseidon_tpu.transport").error(
-                    "fused Pallas kernel unavailable on this backend "
-                    "(%s: %s); using the lax path",
-                    type(e).__name__, e,
-                )
+        out = _try_pallas(solve_device_tiled, "tiled", "_TILED_BROKEN")
     if out is None:
         out = _solve_device(
             *operands, max_iter=max_iter_per_phase, scale=int(scale)

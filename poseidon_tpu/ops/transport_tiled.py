@@ -1,0 +1,468 @@
+"""Tiled Pallas iteration kernel: one launch per push/relabel iteration
+for instances TOO BIG for the fused ladder kernel's VMEM residency.
+
+The 10k-machine full wave solves at [<=256, ~10240]: three persistent
+[E, M] int32 arrays alone exceed VMEM, so ops/transport_fused.py's
+whole-ladder kernel cannot apply.  The lax path works but compiles each
+iteration into ~20 separate XLA kernels — on the tunneled accelerator,
+fixed per-kernel overhead at ~60-100us/op puts the ~550-iteration wave
+at 2-3 s.  This kernel collapses ONE ITERATION (push sweep + excesses +
+local relabel) into ONE ``pallas_call`` whose grid walks column tiles
+sequentially (TPU grids execute in order on one core), streaming
+C/Uem/F tiles HBM->VMEM while cross-tile terms (row-prefix sums for the
+cumsum push allocation, row-max relabel candidates, scalar sink
+prefixes) ride VMEM/SMEM scratch accumulators; row-global and scalar
+state finalizes in the last tile's epilogue.  The Bellman-Ford global
+update (every ``global_every``-th iteration) stays on the XLA path —
+it is only ~1/4 of iterations; fusing it is a follow-up if profiling
+says so.
+
+Arithmetic is IDENTICAL to ops/transport.py's ``_pr_phase`` body —
+chunked inclusive cumsums with carried prefixes produce bit-equal int32
+values — so results are bit-identical (asserted by interpret-mode parity
+tests, like transport_fused's).
+
+Replaces (TPU-native): the innermost solver loop of the external
+cs2/flowlessly min-cost max-flow solvers the reference's Firmament
+shells out to (reference deploy/firmament-deployment.yaml:29-31), at the
+scale tier the fused kernel cannot hold on-chip.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from poseidon_tpu.ops.transport import (
+    _NEG,
+    _POS,
+    INF_COST,
+    _global_update,
+    _relabel_to,
+)
+from poseidon_tpu.ops.transport_fused import _cumsum_cols, _cumsum_rows
+
+# Column-tile width: lane-aligned, small enough that a tile's working set
+# (C/Uem/F tiles + temporaries, ~8 x E*W*4 bytes = ~4 MB at E=256) leaves
+# VMEM headroom for the row/scalar scratch.
+TILE_W = 512
+
+# Tile working-set gate: ~10 live [E, TILE_W] int32 arrays, doubled by
+# Pallas input pipelining, must fit VMEM with headroom -> E * TILE_W <=
+# 2^17 (E <= 256 at the production tile width — the planner's EC
+# ceiling).  Checked per shape so one oversized instance falls back to
+# lax WITHOUT latching the kernel off for the sizes it serves.
+TILE_ELEM_BUDGET = 1 << 17
+
+
+def fits_tile(e_pad: int) -> bool:
+    return e_pad * TILE_W <= TILE_ELEM_BUDGET
+
+
+def _iteration_kernel(
+    # SMEM scalars: [eps, do_relabel, exc_t, pt, total_supply]
+    sc_ref,
+    # VMEM inputs (t = tile index; [E, W] tiled / [E, 1] replicated /
+    # [1, W] tiled)
+    C_ref, Uem_ref, U_ref, sup_ref, cap_ref,
+    F_ref, Ffb_ref, Fmt_ref, pe_ref, pm_ref,
+    exc_e_ref, exc_m_ref,
+    # outputs
+    F_out, Fmt_out, pm_out, exc_m_out,
+    Ffb_out, pe_out, exc_e_out, sco_ref,   # sco: [pt', exc_t'] SMEM
+    # VMEM scratch accumulators (persist across grid steps)
+    row_res_acc,   # [E,1] prefix of res_em row sums (tiles before t)
+    ecp_acc,       # [E,1] total ec_push row sums
+    rowF_acc,      # [E,1] row sums of post-push F
+    adm_e_acc,     # [E,1] bool-as-int: row has admissible arc (machines)
+    cand_e_acc,    # [E,1] max relabel candidate from machine arcs
+    # SMEM scratch scalars
+    s_scr,         # [8]: 0=tm_res prefix, 1=fmt' sum, 2=t_adm flag,
+                   #      3=t cand max, 4=tpm sum (sink pushes to machines)
+):
+    t = pl.program_id(0)
+    n = pl.num_programs(0)
+    E, W = C_ref.shape
+
+    eps = sc_ref[0]
+    do_relabel = sc_ref[1]
+    exc_t = sc_ref[2]
+    pt = sc_ref[3]
+    total = sc_ref[4]
+
+    @pl.when(t == 0)
+    def _init():
+        row_res_acc[:] = jnp.zeros((E, 1), jnp.int32)
+        ecp_acc[:] = jnp.zeros((E, 1), jnp.int32)
+        rowF_acc[:] = jnp.zeros((E, 1), jnp.int32)
+        adm_e_acc[:] = jnp.zeros((E, 1), jnp.int32)
+        cand_e_acc[:] = jnp.full((E, 1), _NEG, jnp.int32)
+        s_scr[0] = 0
+        s_scr[1] = 0
+        s_scr[2] = 0
+        s_scr[3] = _NEG
+        s_scr[4] = 0
+
+    C = C_ref[:]
+    adm = C < INF_COST
+    Uem = Uem_ref[:]
+    F = F_ref[:]
+    Fmt = Fmt_ref[:]
+    pe = pe_ref[:]
+    pm = pm_ref[:]
+    exc_e = exc_e_ref[:]
+    exc_m = exc_m_ref[:]
+    cap = cap_ref[:]
+
+    rc_em = jnp.where(adm, C + pe - pm, _POS)
+    rc_mt = pm - pt                          # [1, W]
+
+    # === push sweep (same allocation order as the lax body) ===
+    res_em = jnp.where((rc_em < 0) & (exc_e > 0), Uem - F, 0)
+    before = _cumsum_cols(res_em) - res_em + row_res_acc[:]
+    ec_push = jnp.clip(jnp.minimum(res_em, exc_e - before), 0, None)
+    row_res_acc[:] = row_res_acc[:] + jnp.sum(res_em, axis=1,
+                                              keepdims=True)
+    ecp_acc[:] = ecp_acc[:] + jnp.sum(ec_push, axis=1, keepdims=True)
+
+    mt_push = jnp.where(
+        (rc_mt < 0) & (exc_m > 0), jnp.minimum(cap - Fmt, exc_m), 0
+    )
+    left_m = exc_m - mt_push
+    res_me = jnp.where((rc_em > 0) & (left_m > 0), F, 0)
+    before_me = _cumsum_rows(res_me) - res_me
+    me_push = jnp.clip(jnp.minimum(res_me, left_m - before_me), 0, None)
+
+    # Sink row, machine part (cross-tile scalar prefix; EC part is in
+    # the epilogue, offset by the machine part's TOTAL).
+    texc = jnp.where(exc_t > 0, 1, 0)
+    res_t_m = jnp.where((-rc_mt < 0), Fmt, 0) * texc
+    before_tm = _cumsum_cols(res_t_m) - res_t_m + s_scr[0]
+    t_push_m = jnp.clip(jnp.minimum(res_t_m, exc_t - before_tm), 0, None)
+    s_scr[0] = s_scr[0] + jnp.sum(res_t_m)
+
+    F_new = F + ec_push - me_push
+    Fmt_new = Fmt + mt_push - t_push_m
+    exc_m_new = jnp.sum(F_new, axis=0, keepdims=True) - Fmt_new
+
+    F_out[:] = F_new
+    Fmt_out[:] = Fmt_new
+    exc_m_out[:] = exc_m_new
+    rowF_acc[:] = rowF_acc[:] + jnp.sum(F_new, axis=1, keepdims=True)
+    s_scr[1] = s_scr[1] + jnp.sum(Fmt_new)
+
+    # === pm relabel (column-local; identical to local_relabel) ===
+    mt_open = cap - Fmt_new > 0
+    has_adm_m = (
+        ((rc_mt < 0) & mt_open)
+        | jnp.any((rc_em > 0) & (F_new > 0), axis=0, keepdims=True)
+    )
+    maxcand_m = jnp.maximum(
+        jnp.where(mt_open, pt, _NEG),
+        jnp.max(jnp.where((F_new > 0) & adm, pe + C, _NEG),
+                axis=0, keepdims=True),
+    )
+    pm_new = _relabel_to(maxcand_m, has_adm_m, exc_m_new, pm, eps)
+    pm_out[:] = jnp.where(do_relabel == 1, pm_new, pm)
+
+    # === pe / pt relabel accumulators (finalized in the epilogue) ===
+    res_em2 = Uem - F_new
+    has_em = res_em2 > 0
+    adm_e_acc[:] = adm_e_acc[:] | jnp.any(
+        (rc_em < 0) & has_em, axis=1, keepdims=True
+    ).astype(jnp.int32)
+    cand_e_acc[:] = jnp.maximum(
+        cand_e_acc[:],
+        jnp.max(jnp.where(has_em & adm, pm - C, _NEG), axis=1,
+                keepdims=True),
+    )
+    s_scr[2] = s_scr[2] | jnp.any(
+        (-rc_mt < 0) & (Fmt_new > 0)
+    ).astype(jnp.int32)
+    s_scr[3] = jnp.maximum(
+        s_scr[3], jnp.max(jnp.where(Fmt_new > 0, pm, _NEG))
+    )
+
+    # === epilogue: fallback/sink EC arcs, row/scalar state, pe/pt ===
+    @pl.when(t == n - 1)
+    def _epilogue():
+        Ffb = Ffb_ref[:]
+        sup = sup_ref[:]
+        U = U_ref[:]
+        rc_fb = U + pe - pt
+
+        left_e = exc_e - ecp_acc[:]
+        fb_push = jnp.where(
+            (rc_fb < 0) & (left_e > 0),
+            jnp.minimum(sup - Ffb, left_e), 0,
+        )
+        res_t_e = jnp.where((-rc_fb < 0), Ffb, 0) * texc
+        before_te = _cumsum_rows(res_t_e) - res_t_e + s_scr[0]
+        t_push_e = jnp.clip(
+            jnp.minimum(res_t_e, exc_t - before_te), 0, None
+        )
+        Ffb_new = Ffb + fb_push - t_push_e
+        Ffb_out[:] = Ffb_new
+
+        exc_e_new = sup - rowF_acc[:] - Ffb_new
+        exc_e_out[:] = exc_e_new
+        exc_t_new = s_scr[1] + jnp.sum(Ffb_new) - total
+
+        fb_open = sup - Ffb_new > 0
+        has_adm_e = (adm_e_acc[:] > 0) | ((rc_fb < 0) & fb_open)
+        maxcand_e = jnp.maximum(
+            cand_e_acc[:], jnp.where(fb_open, pt - U, _NEG)
+        )
+        pe_new = _relabel_to(maxcand_e, has_adm_e, exc_e_new, pe, eps)
+        pe_out[:] = jnp.where(do_relabel == 1, pe_new, pe)
+
+        has_adm_t = (s_scr[2] > 0) | jnp.any((-rc_fb < 0) & (Ffb_new > 0))
+        maxcand_t = jnp.maximum(
+            s_scr[3], jnp.max(jnp.where(Ffb_new > 0, pe + U, _NEG))
+        )
+        pt_new = _relabel_to(
+            maxcand_t, has_adm_t, exc_t_new, pt, eps
+        )
+        sco_ref[0] = jnp.where(do_relabel == 1, pt_new, pt)
+        sco_ref[1] = exc_t_new
+
+
+def _tiled_iteration(C, Uem, U2, sup2, cap2, F, Ffb2, Fmt2, pe2, pm2, pt,
+                     exc_e2, exc_m2, exc_t, eps, do_relabel, total, *,
+                     interpret):
+    """One push(+relabel) iteration as a single pallas_call.
+
+    All operands already kernel-shaped: [E, Mk] matrices (Mk a multiple
+    of TILE_W), [E, 1] row vectors, [1, Mk] column vectors, scalars as
+    int32.  Returns the new (F, Ffb2, Fmt2, pe2, pm2, pt, exc_e2,
+    exc_m2, exc_t).
+    """
+    E, Mk = C.shape
+    n_tiles = Mk // TILE_W
+    sc = jnp.stack([
+        jnp.asarray(eps, jnp.int32),
+        jnp.asarray(do_relabel, jnp.int32),
+        jnp.asarray(exc_t, jnp.int32),
+        jnp.asarray(pt, jnp.int32),
+        jnp.asarray(total, jnp.int32),
+    ])
+
+    tiled = pl.BlockSpec((E, TILE_W), lambda t: (0, t),
+                         memory_space=pltpu.VMEM)
+    col_tiled = pl.BlockSpec((1, TILE_W), lambda t: (0, t),
+                             memory_space=pltpu.VMEM)
+    row_repl = pl.BlockSpec((E, 1), lambda t: (0, 0),
+                            memory_space=pltpu.VMEM)
+    out_shapes = (
+        jax.ShapeDtypeStruct((E, Mk), jnp.int32),    # F
+        jax.ShapeDtypeStruct((1, Mk), jnp.int32),    # Fmt
+        jax.ShapeDtypeStruct((1, Mk), jnp.int32),    # pm
+        jax.ShapeDtypeStruct((1, Mk), jnp.int32),    # exc_m
+        jax.ShapeDtypeStruct((E, 1), jnp.int32),     # Ffb
+        jax.ShapeDtypeStruct((E, 1), jnp.int32),     # pe
+        jax.ShapeDtypeStruct((E, 1), jnp.int32),     # exc_e
+        jax.ShapeDtypeStruct((2,), jnp.int32),       # [pt', exc_t']
+    )
+    (F_n, Fmt_n, pm_n, exc_m_n, Ffb_n, pe_n, exc_e_n, sco) = pl.pallas_call(
+        _iteration_kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # sc
+            tiled, tiled, row_repl, row_repl, col_tiled,
+            tiled, row_repl, col_tiled, row_repl, col_tiled,
+            row_repl, col_tiled,
+        ],
+        out_specs=(
+            tiled, col_tiled, col_tiled, col_tiled,
+            row_repl, row_repl, row_repl,
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
+        out_shape=out_shapes,
+        scratch_shapes=[
+            pltpu.VMEM((E, 1), jnp.int32),   # row_res_acc
+            pltpu.VMEM((E, 1), jnp.int32),   # ecp_acc
+            pltpu.VMEM((E, 1), jnp.int32),   # rowF_acc
+            pltpu.VMEM((E, 1), jnp.int32),   # adm_e_acc
+            pltpu.VMEM((E, 1), jnp.int32),   # cand_e_acc
+            pltpu.SMEM((8,), jnp.int32),     # s_scr
+        ],
+        interpret=interpret,
+    )(sc, C, Uem, U2, sup2, cap2, F, Ffb2, Fmt2, pe2, pm2, exc_e2,
+      exc_m2)
+    return F_n, Ffb_n, Fmt_n, pe_n, pm_n, sco[0], exc_e_n, exc_m_n, sco[1]
+
+
+def _pr_phase_tiled(carry, eps, *, C, Uem, U2, sup2, cap2, total,
+                    max_iter, max_iter_total, global_every, bf_max,
+                    interpret):
+    """transport._pr_phase with the iteration body as one kernel launch.
+
+    Operands are kernel-shaped (see _tiled_iteration); the refine step
+    and the BF global update remain plain XLA (once per phase / every
+    global_every-th iteration).  ``_global_update`` is reused verbatim
+    from transport.py with reshaped views, so its arithmetic — and the
+    bf-sweep accounting — matches the lax path exactly.
+    """
+    (F_in, Ffb_in, Fmt_in, pe, pm, pt, total_iters, total_bf) = carry
+    E, Mk = C.shape
+    adm = C < INF_COST
+
+    budget_left = total_iters + 64 < max_iter_total
+
+    def refine(rc, flow, hi):
+        ref = jnp.where(rc < -eps, hi, jnp.where(rc > eps, 0, flow))
+        return jnp.where(budget_left, ref, flow)
+
+    rc_em = jnp.where(adm, C + pe - pm, _POS)
+    F = refine(rc_em, F_in, Uem)
+    Ffb = refine(U2 + pe - pt, Ffb_in, sup2)
+    Fmt = refine(pm - pt, Fmt_in, cap2)
+
+    def excesses(F, Ffb, Fmt):
+        exc_e = sup2 - jnp.sum(F, axis=1, keepdims=True) - Ffb
+        exc_m = jnp.sum(F, axis=0, keepdims=True) - Fmt
+        exc_t = jnp.sum(Fmt) + jnp.sum(Ffb) - total
+        return exc_e, exc_m, exc_t
+
+    exc_e, exc_m, exc_t = excesses(F, Ffb, Fmt)
+
+    def cond(st):
+        (_F, _Ffb, _Fmt, exc_e, exc_m, exc_t, _pe, _pm, _pt, it,
+         _bf) = st
+        active = jnp.any(exc_e > 0) | jnp.any(exc_m > 0) | (exc_t > 0)
+        return (
+            (it < max_iter) & (total_iters + it < max_iter_total) & active
+        )
+
+    def body(st):
+        F, Ffb, Fmt, exc_e, exc_m, exc_t, pe, pm, pt, it, bf = st
+        active = (
+            (jnp.any(exc_e > 0) | jnp.any(exc_m > 0) | (exc_t > 0))
+            & (it < max_iter)
+            & (total_iters + it < max_iter_total)
+        )
+        is_global = (it % global_every == 0) & active
+
+        (F2, Ffb2, Fmt2, pe2, pm2, pt2, exc_e2, exc_m2,
+         exc_t2) = _tiled_iteration(
+            C, Uem, U2, sup2, cap2, F, Ffb, Fmt, pe, pm, pt,
+            exc_e, exc_m, exc_t, eps,
+            jnp.where(is_global, 0, 1), total, interpret=interpret,
+        )
+
+        def global_up(_):
+            # transport._global_update speaks 1-D [E]/[M] vectors and a
+            # scalar pt; bridge the 2-D kernel shapes through reshapes
+            # (pure views — bit-identical arithmetic).
+            pe_n, pm_n, pt_n, sweeps = _global_update(
+                F2, Ffb2[:, 0], Fmt2[0], pe2[:, 0], pm2[0], pt2,
+                exc_e2[:, 0], exc_m2[0], exc_t2,
+                C=C, U=U2[:, 0], Uem=Uem, supply=sup2[:, 0],
+                cap=cap2[0], admissible_arcs=adm, eps=eps, bf_max=bf_max,
+            )
+            return pe_n[:, None], pm_n[None, :], pt_n, sweeps
+
+        def keep(_):
+            return pe2, pm2, pt2, jnp.int32(0)
+
+        pe3, pm3, pt3, sweeps = lax.cond(
+            is_global, global_up, keep, operand=None
+        )
+
+        def sel(new, old):
+            return jnp.where(active, new, old)
+
+        return (
+            sel(F2, F), sel(Ffb2, Ffb), sel(Fmt2, Fmt),
+            sel(exc_e2, exc_e), sel(exc_m2, exc_m), sel(exc_t2, exc_t),
+            sel(pe3, pe), sel(pm3, pm), sel(pt3, pt),
+            it + active.astype(jnp.int32), bf + sweeps,
+        )
+
+    init = (F, Ffb, Fmt, exc_e, exc_m, exc_t, pe, pm, pt,
+            jnp.int32(0), jnp.int32(0))
+    (F, Ffb, Fmt, _ee, _em, _et, pe, pm, pt, iters, bf) = lax.while_loop(
+        cond, body, init
+    )
+    return (
+        F, Ffb, Fmt, pe, pm, pt, total_iters + iters, total_bf + bf
+    ), iters
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_iter", "scale", "interpret")
+)
+def solve_device_tiled(costs, supply, capacity, unsched_cost, arc_cap,
+                       init_prices, init_flows, init_fb, eps_sched,
+                       max_iter_total, global_every, bf_max, *,
+                       max_iter, scale, interpret=False):
+    """Drop-in twin of transport._solve_device with the iteration body as
+    one tiled kernel launch.  Same operand contract, same outputs,
+    bit-identical results (interpret-mode parity tests).
+
+    Operands re-pad here to kernel alignment (rows to 8 sublanes, lanes
+    to TILE_W) with inert rows/columns, stripped on return.
+    """
+    E, M = costs.shape
+    Ek = -(-E // 8) * 8
+    Mk = -(-M // TILE_W) * TILE_W
+
+    def pad2(x, fill):
+        return jnp.pad(x, ((0, Ek - E), (0, Mk - M)), constant_values=fill)
+
+    costs_k = pad2(costs, INF_COST)
+    C = jnp.where(
+        costs_k >= INF_COST, INF_COST, costs_k * scale
+    ).astype(jnp.int32)
+    supply_k = jnp.pad(supply.astype(jnp.int32), (0, Ek - E))
+    cap_k = jnp.pad(capacity.astype(jnp.int32), (0, Mk - M))
+    U = jnp.pad(
+        (unsched_cost * scale).astype(jnp.int32), (0, Ek - E),
+        constant_values=scale,
+    )
+    total = jnp.sum(supply_k)
+    Uem = jnp.minimum(
+        jnp.minimum(supply_k[:, None], cap_k[None, :]),
+        pad2(arc_cap.astype(jnp.int32), 0),
+    )
+
+    pe = jnp.pad(init_prices[:E], (0, Ek - E))
+    pm = jnp.pad(init_prices[E:E + M], (0, Mk - M))
+    pt = init_prices[E + M]
+
+    F0 = jnp.clip(pad2(init_flows, 0), 0, Uem)
+    F0 = jnp.where(costs_k < INF_COST, F0, 0)
+    F0 = jnp.where((jnp.sum(F0, axis=1) <= supply_k)[:, None], F0, 0)
+    Ffb0 = jnp.clip(
+        jnp.pad(init_fb, (0, Ek - E)), 0, supply_k - jnp.sum(F0, axis=1)
+    )
+    Fmt0 = jnp.minimum(jnp.sum(F0, axis=0), cap_k)
+
+    phase = functools.partial(
+        _pr_phase_tiled, C=C, Uem=Uem, U2=U[:, None],
+        sup2=supply_k[:, None], cap2=cap_k[None, :], total=total,
+        max_iter=max_iter, max_iter_total=max_iter_total,
+        global_every=global_every, bf_max=bf_max, interpret=interpret,
+    )
+    carry0 = (F0, Ffb0[:, None], Fmt0[None, :], pe[:, None], pm[None, :],
+              pt.astype(jnp.int32), jnp.int32(0), jnp.int32(0))
+    (F, Ffb2, Fmt2, pe2, pm2, pt2, iters, bf), phase_iters = lax.scan(
+        phase, carry0, eps_sched
+    )
+    prices = jnp.concatenate(
+        [pe2[:E, 0], pm2[0, :M], pt2[None]]
+    )
+    exc_e = (
+        supply_k[:, None] - jnp.sum(F, axis=1, keepdims=True) - Ffb2
+    )
+    exc_m = jnp.sum(F, axis=0, keepdims=True) - Fmt2
+    exc_t = jnp.sum(Fmt2) + jnp.sum(Ffb2) - total
+    clean = jnp.all(exc_e == 0) & jnp.all(exc_m == 0) & (exc_t == 0)
+    return (
+        F[:E, :M], Ffb2[:E, 0], prices, iters, bf, clean, phase_iters
+    )

@@ -1,0 +1,143 @@
+"""Tiled per-iteration Pallas kernel: BIT-parity with the lax path.
+
+Same contract as test_transport_fused: identical int32 update sequence,
+so flows/prices/iterations/bf/phase splits must be EQUAL, not merely
+cost-equal.  Interpret mode (no TPU in CI) via POSEIDON_TILED=1; shapes
+chosen to span multiple column tiles (M > TILE_W).
+"""
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.ops import transport
+from poseidon_tpu.ops.transport import solve_transport
+from poseidon_tpu.ops import transport_tiled
+
+
+@pytest.fixture(autouse=True)
+def small_tiles(monkeypatch):
+    # Multi-tile coverage at test-friendly sizes: 3 tiles of 128 lanes
+    # instead of 512-wide production tiles, and a tiny VMEM budget so
+    # these instances land ABOVE it (the tiled tier's precondition —
+    # without this the gate routes them to the lax/fused tiers and the
+    # parity assertions are vacuous).
+    monkeypatch.setattr(transport_tiled, "TILE_W", 128)
+    from poseidon_tpu.ops import transport_fused
+
+    monkeypatch.setattr(transport_fused, "VMEM_ELEM_BUDGET", 1024)
+    # Prove the kernel actually ran on the POSEIDON_TILED=1 leg.
+    calls = {"n": 0}
+    real = transport_tiled.solve_device_tiled
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    monkeypatch.setattr(
+        transport_tiled, "solve_device_tiled", counting
+    )
+    yield calls
+
+
+def _instance(E, M, seed, contended=False):
+    rng = np.random.default_rng(seed)
+    costs = rng.integers(0, 1000, size=(E, M)).astype(np.int32)
+    costs[rng.random((E, M)) < 0.1] = transport.INF_COST
+    supply = rng.integers(1, 9, size=E).astype(np.int32)
+    cap = (
+        np.full(M, max(1, int(supply.sum()) // (2 * M) + 1), np.int32)
+        if contended
+        else rng.integers(1, 8, size=M).astype(np.int32)
+    )
+    unsched = rng.integers(1000, 2000, size=E).astype(np.int32)
+    arc = rng.integers(1, 6, size=(E, M)).astype(np.int32)
+    return costs, supply, cap, unsched, arc
+
+
+def _solve_both(monkeypatch, small_tiles, *args, **kw):
+    monkeypatch.setenv("POSEIDON_TILED", "0")
+    monkeypatch.setenv("POSEIDON_FUSED", "0")
+    lax_sol = solve_transport(*args, **kw)
+    monkeypatch.setenv("POSEIDON_TILED", "1")
+    before = small_tiles["n"]
+    tiled_sol = solve_transport(*args, **kw)
+    assert small_tiles["n"] == before + 1, "tiled kernel did not run"
+    assert not transport._TILED_BROKEN
+    return lax_sol, tiled_sol
+
+
+def _assert_bit_equal(a, b):
+    np.testing.assert_array_equal(a.flows, b.flows)
+    np.testing.assert_array_equal(a.unsched, b.unsched)
+    np.testing.assert_array_equal(a.prices, b.prices)
+    assert a.objective == b.objective
+    assert a.gap_bound == b.gap_bound
+    assert a.iterations == b.iterations
+    assert a.bf_sweeps == b.bf_sweeps
+    assert a.phase_iters == b.phase_iters
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_tiled_bit_parity_cold(monkeypatch, small_tiles, seed):
+    # M=300 pads to 320-bucket then 384 kernel lanes = 3 tiles of 128.
+    costs, supply, cap, unsched, arc = _instance(12, 300, seed)
+    a, b = _solve_both(
+        monkeypatch, small_tiles, costs, supply, cap, unsched,
+        arc_capacity=arc,
+    )
+    _assert_bit_equal(a, b)
+    assert a.gap_bound == 0.0
+
+
+def test_tiled_bit_parity_contended(monkeypatch, small_tiles):
+    # Contention: multi-phase ladders, global updates, sink push-back.
+    costs, supply, cap, unsched, arc = _instance(
+        10, 260, 7, contended=True
+    )
+    a, b = _solve_both(
+        monkeypatch, small_tiles, costs, supply, cap, unsched,
+        arc_capacity=arc,
+    )
+    _assert_bit_equal(a, b)
+    assert a.iterations > 0
+
+
+def test_tiled_bit_parity_warm_start(monkeypatch, small_tiles):
+    costs, supply, cap, unsched, arc = _instance(10, 260, 11)
+    monkeypatch.setenv("POSEIDON_TILED", "0")
+    monkeypatch.setenv("POSEIDON_FUSED", "0")
+    first = solve_transport(costs, supply, cap, unsched, arc_capacity=arc)
+    costs2 = np.where(
+        costs < transport.INF_COST, costs + 3, costs
+    ).astype(np.int32)
+    kw = dict(
+        arc_capacity=arc, init_flows=first.flows,
+        init_unsched=first.unsched, eps_start=4 * 97,
+    )
+    a, b = _solve_both(
+        monkeypatch, small_tiles, costs2, supply, cap, unsched,
+        first.prices, **kw
+    )
+    _assert_bit_equal(a, b)
+
+
+def test_use_tiled_gate(monkeypatch):
+    from poseidon_tpu.ops import transport_fused
+
+    # The autouse fixture shrinks the VMEM budget / tile width for the
+    # parity tests; the gate semantics are defined against production.
+    monkeypatch.setattr(transport_fused, "VMEM_ELEM_BUDGET", 1 << 18)
+    monkeypatch.setattr(transport_tiled, "TILE_W", 512)
+    monkeypatch.delenv("POSEIDON_TILED", raising=False)
+    monkeypatch.setattr(transport, "_TILED_BROKEN", False)
+    # CPU backend: off by default.
+    assert not transport._use_tiled(256, 10240)
+    monkeypatch.setenv("POSEIDON_TILED", "1")
+    assert transport._use_tiled(256, 10240)
+    # VMEM-sized instances belong to the fused kernel, not this one.
+    assert not transport._use_tiled(128, 1024)
+    # Row-bound: a column tile's working set must fit.
+    assert not transport._use_tiled(1024, 10240)
+    # The broken latch wins over the force flag.
+    monkeypatch.setattr(transport, "_TILED_BROKEN", True)
+    assert not transport._use_tiled(256, 10240)
