@@ -1,6 +1,7 @@
-"""A/B the fused Pallas ladder kernel against the lax path on the live
-backend: correctness (bit-parity) first, then wall-clock at the churn-
-and selective-representative shapes the fused kernel targets.
+"""A/B the Pallas kernels (fused ladder + tiled iteration) against the
+lax path on the live backend: correctness (bit-parity) first, then
+wall-clock at the shapes each kernel targets — churn/selective widths
+for the fused ladder, wave widths for the tiled iteration kernel.
 
 Usage (serialize against other chip users; never external-kill this):
     python tools/bench_fused.py [--reps 5]
@@ -35,8 +36,10 @@ def make_instance(E, M, seed, contended):
     return costs, supply, capacity, unsched
 
 
-def run(mode, inst, reps):
-    os.environ["POSEIDON_FUSED"] = mode
+def run(env_var, mode, inst, reps):
+    os.environ["POSEIDON_FUSED"] = "0"
+    os.environ["POSEIDON_TILED"] = "0"
+    os.environ[env_var] = mode
     from poseidon_tpu.ops.transport import solve_transport
 
     costs, supply, capacity, unsched = inst
@@ -45,6 +48,36 @@ def run(mode, inst, reps):
     for _ in range(reps):
         sol = solve_transport(costs, supply, capacity, unsched)
     return (time.perf_counter() - t0) / reps, sol
+
+
+def ab(kernel, env_var, latch, shapes, reps):
+    from poseidon_tpu.ops import transport
+
+    for E, M, cont in shapes:
+        inst = make_instance(E, M, seed=7, contended=cont)
+        t_lax, s_lax = run(env_var, "0", inst, reps)
+        t_k, s_k = run(env_var, "1", inst, reps)
+        if getattr(transport, latch):
+            # The whole point is Mosaic validation: a silently-latched
+            # lax fallback must FAIL, not report a 1.00x "pass".
+            print(f"FAIL: {kernel} kernel did not lower on this backend "
+                  "(fallback latched); see the log above", flush=True)
+            raise SystemExit(1)
+        ok = (
+            s_lax.objective == s_k.objective
+            and s_lax.iterations == s_k.iterations
+            and np.array_equal(s_lax.flows, s_k.flows)
+            and np.array_equal(s_lax.prices, s_k.prices)
+        )
+        print(
+            f"[{kernel} {E}x{M}{' cont' if cont else ''}] "
+            f"lax {t_lax * 1000:.1f}ms {kernel} {t_k * 1000:.1f}ms "
+            f"speedup {t_lax / t_k:.2f}x iters={s_lax.iterations} "
+            f"bit-parity={'OK' if ok else 'FAIL'}",
+            flush=True,
+        )
+        if not ok:
+            raise SystemExit(1)
 
 
 def main():
@@ -67,41 +100,23 @@ def main():
     import jax
 
     print(f"backend: {jax.devices()[0].platform}", flush=True)
-    shapes = [
+    fused_shapes = [
         (64, 512, False),    # small churn
         (128, 1024, True),   # selective width, contended
         (128, 2048, True),   # VMEM-budget edge
     ]
+    tiled_shapes = [
+        (128, 4096, False),  # above VMEM: the wave tier
+        (128, 10000, True),  # the 10k-machine wave shape, contended
+    ]
     if os.environ.get("POSEIDON_BENCH_FUSED_SMOKE"):
         # CPU smoke: interpret-mode Pallas is an emulator — keep it tiny.
-        shapes = [(16, 128, False)]
-    for E, M, cont in shapes:
-        inst = make_instance(E, M, seed=7, contended=cont)
-        t_lax, s_lax = run("0", inst, args.reps)
-        t_fused, s_fused = run("1", inst, args.reps)
-        from poseidon_tpu.ops import transport
-
-        if transport._FUSED_BROKEN:
-            # The whole point of this bench is Mosaic validation: a
-            # silently-latched lax fallback must FAIL it, not produce a
-            # 1.00x "pass" that never ran the kernel.
-            print("FAIL: fused kernel did not lower on this backend "
-                  "(fallback latched); see the log above", flush=True)
-            raise SystemExit(1)
-        ok = (
-            s_lax.objective == s_fused.objective
-            and s_lax.iterations == s_fused.iterations
-            and np.array_equal(s_lax.flows, s_fused.flows)
-            and np.array_equal(s_lax.prices, s_fused.prices)
-        )
-        print(
-            f"[{E}x{M}{' cont' if cont else ''}] lax {t_lax * 1000:.1f}ms "
-            f"fused {t_fused * 1000:.1f}ms speedup {t_lax / t_fused:.2f}x "
-            f"iters={s_lax.iterations} bit-parity={'OK' if ok else 'FAIL'}",
-            flush=True,
-        )
-        if not ok:
-            raise SystemExit(1)
+        fused_shapes = [(16, 128, False)]
+        tiled_shapes = []
+    ab("fused", "POSEIDON_FUSED", "_FUSED_BROKEN", fused_shapes,
+       args.reps)
+    ab("tiled", "POSEIDON_TILED", "_TILED_BROKEN", tiled_shapes,
+       args.reps)
 
 
 if __name__ == "__main__":
