@@ -369,6 +369,161 @@ def run_trace(machines: int, tasks: int, rounds: int) -> dict:
     return out
 
 
+def run_features(machines: int, rounds: int) -> dict:
+    """BASELINE configs 2-4 at cluster scale: node selectors (2),
+    pod-level affinity with multi-round scheduling (3), gang
+    scheduling (4).  Each sub-report carries both the latency AND the
+    semantic predicate (violations must be zero) — a fast round that
+    breaks affinity/atomicity would be worthless.
+    """
+    import jax
+
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.costmodel.selectors import IN_SET
+    from poseidon_tpu.graph.instance import RoundPlanner
+    from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+    from poseidon_tpu.utils.ids import generate_uuid, task_uid
+
+    out = {"backend": jax.devices()[0].platform, "ok": False}
+    tasks = machines * 5
+
+    # --- config 2: node selectors (half the fleet labeled; selector
+    # tasks must land only there, plain tasks anywhere).
+    state = ClusterState()
+    for i in range(machines):
+        state.node_added(MachineInfo(
+            uuid=generate_uuid(f"feat-m{i}"), cpu_capacity=32000,
+            ram_capacity=128 << 20, task_slots=64,
+            labels={"zone": "z1" if i % 2 == 0 else "z2"},
+        ))
+    zoned = {}
+    for i in range(tasks):
+        sel = ((IN_SET, "zone", ("z1",)),) if i % 4 == 0 else ()
+        uid = task_uid("feat-sel", i)
+        zoned[uid] = bool(sel)
+        state.task_submitted(TaskInfo(
+            uid=uid, job_id=f"fj{i % 16}", cpu_request=200,
+            ram_request=1 << 19, selectors=sel,
+        ))
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+    lat = []
+    m = None
+    for r in range(rounds):
+        t0 = time.perf_counter()
+        _, m = planner.schedule_round()
+        lat.append(time.perf_counter() - t0)
+        submit_population(state, tasks // 100, 16, seed=r + 1)  # churn
+    violations = sum(
+        1 for uid, is_zoned in zoned.items()
+        if is_zoned and (t := state.tasks.get(uid)) is not None
+        and t.scheduled_to is not None
+        and state.machines[t.scheduled_to].labels.get("zone") != "z1"
+    )
+    out["selectors"] = {
+        "round_p50_s": (
+            round(float(np.percentile(lat, 50)), 4) if lat else 0.0
+        ),
+        "violations": violations,
+        "placed": m.placed if m is not None else 0,
+    }
+    # Partial line per completed stage (the parent salvages these on a
+    # timeout, same contract as the rung/trace children).
+    print(json.dumps(out), flush=True)
+
+    # --- config 3: pod-level affinity, multi-round (follower tasks
+    # co-locate with a running "db" target placed in an earlier round).
+    state = ClusterState()
+    for i in range(machines):
+        state.node_added(MachineInfo(
+            uuid=generate_uuid(f"aff-m{i}"), cpu_capacity=32000,
+            ram_capacity=128 << 20, task_slots=64,
+        ))
+    n_targets = machines // 10
+    for i in range(n_targets):
+        state.task_submitted(TaskInfo(
+            uid=task_uid("aff-db", i), job_id="aff-db",
+            cpu_request=500, ram_request=1 << 19,
+            labels={"app": f"db{i}"},
+        ))
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+    planner.schedule_round()  # targets land and RUN
+    for i in range(n_targets):
+        state.task_submitted(TaskInfo(
+            uid=task_uid("aff-web", i), job_id="aff-web",
+            cpu_request=200, ram_request=1 << 19,
+            pod_affinity=((IN_SET, "app", (f"db{i}",)),),
+        ))
+    t0 = time.perf_counter()
+    planner.schedule_round()
+    aff_s = time.perf_counter() - t0
+    colocated = sum(
+        1 for i in range(n_targets)
+        if state.tasks[task_uid("aff-web", i)].scheduled_to is not None
+        and state.tasks[task_uid("aff-web", i)].scheduled_to
+        == state.tasks[task_uid("aff-db", i)].scheduled_to
+    )
+    out["pod_affinity"] = {
+        "round_s": round(aff_s, 4),
+        "targets": n_targets,
+        "colocated": colocated,
+    }
+    print(json.dumps(out), flush=True)
+
+    # --- config 4: gang scheduling (feasible gangs place whole;
+    # an oversized gang places nothing — atomicity at scale).
+    state = ClusterState()
+    for i in range(machines):
+        state.node_added(MachineInfo(
+            uuid=generate_uuid(f"gang-m{i}"), cpu_capacity=32000,
+            ram_capacity=128 << 20, task_slots=8,
+        ))
+    gang_size = 32
+    n_gangs = machines // 20
+    for g in range(n_gangs):
+        for i in range(gang_size):
+            state.task_submitted(TaskInfo(
+                uid=task_uid(f"gang{g}", i), job_id=f"gang-{g}",
+                cpu_request=1000, ram_request=1 << 20, gang=True,
+            ))
+    # One gang that can never fit (more members than total free slots
+    # after the others): atomicity demands zero of it places.
+    big = machines * 8 + 1
+    for i in range(big):
+        state.task_submitted(TaskInfo(
+            uid=task_uid("gang-big", i), job_id="gang-big",
+            cpu_request=100, ram_request=1 << 18, gang=True,
+        ))
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+    t0 = time.perf_counter()
+    _, mg = planner.schedule_round()
+    gang_s = time.perf_counter() - t0
+    partial_gangs = 0
+    for g in range(n_gangs):
+        placed_n = sum(
+            1 for i in range(gang_size)
+            if state.tasks[task_uid(f"gang{g}", i)].scheduled_to
+        )
+        if 0 < placed_n < gang_size:
+            partial_gangs += 1
+    big_placed = sum(
+        1 for i in range(big)
+        if state.tasks[task_uid("gang-big", i)].scheduled_to
+    )
+    out["gang"] = {
+        "round_s": round(gang_s, 4),
+        "gangs": n_gangs,
+        "partial_gangs": partial_gangs,
+        "oversized_gang_placed": big_placed,
+    }
+    out["ok"] = (
+        violations == 0
+        and colocated == n_targets
+        and partial_gangs == 0
+        and big_placed == 0
+    )
+    return out
+
+
 def run_parity() -> dict:
     """BASELINE config 1 (100 nodes / 1k pods): TPU solver objective must
     equal the exact host oracle on the same transportation instance."""
@@ -465,7 +620,8 @@ def main(argv=None) -> int:
     p.add_argument("--ecs", type=int, default=100)
     p.add_argument("--rounds", type=int, default=5)
     p.add_argument("--verbose", action="store_true")
-    p.add_argument("--child", choices=["rung", "parity", "trace"],
+    p.add_argument("--child",
+                   choices=["rung", "parity", "trace", "features"],
                    default=None)
     args = p.parse_args(argv)
 
@@ -490,6 +646,9 @@ def main(argv=None) -> int:
     if args.child == "trace":
         print(json.dumps(run_trace(args.machines, args.tasks, args.rounds)))
         return 0
+    if args.child == "features":
+        print(json.dumps(run_features(args.machines, args.rounds)))
+        return 0
 
     # ---- parent: drive the stages; never touches jax, and re-emits the
     # running JSON line after EVERY stage, so even if this process is
@@ -502,6 +661,7 @@ def main(argv=None) -> int:
     rungs = []
     parity = {"ok": False, "error": "not run"}
     trace = {"ok": False, "error": "not run"}
+    features = {"ok": False, "error": "not run"}
 
     def emit():
         best = None
@@ -518,6 +678,10 @@ def main(argv=None) -> int:
             "parity_ok": parity.get("parity_ok", False),
             "parity": parity,
             "trace": trace,
+            # BASELINE configs 2-4: selectors / pod affinity / gang, with
+            # semantic predicates (violations must be zero) next to the
+            # latency numbers.
+            "features": features,
             "ladder": rungs,
         }
         if best is None:
@@ -553,6 +717,10 @@ def main(argv=None) -> int:
 
     emit()  # a valid (empty-ladder) line exists before any child runs
     parity = _child("parity", [], PARITY_TIMEOUT_S)
+    emit()
+    features = _child("features", [
+        "--machines", "1000", "--rounds", "3",
+    ], PARITY_TIMEOUT_S)
     emit()
     for machines, tasks in ladder:
         res = _child("rung", [

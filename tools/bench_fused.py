@@ -52,8 +52,23 @@ def run(env_var, mode, inst, reps):
 
 def ab(kernel, env_var, latch, shapes, reps):
     from poseidon_tpu.ops import transport
+    from poseidon_tpu.ops.transport import padded_shape
 
     for E, M, cont in shapes:
+        # The forced leg must actually ROUTE through the kernel: if the
+        # shape gate declines (VMEM/tile budget), both legs run lax and
+        # the "pass" is vacuous — fail the configuration instead.
+        e_pad, m_pad = padded_shape(E, M)
+        gate = (
+            transport._use_fused if kernel == "fused"
+            else transport._use_tiled
+        )
+        os.environ[env_var] = "1"
+        if not gate(e_pad, m_pad):
+            print(f"FAIL: {kernel} gate declines shape {E}x{M} "
+                  f"(padded {e_pad}x{m_pad}); fix the shape list",
+                  flush=True)
+            raise SystemExit(1)
         inst = make_instance(E, M, seed=7, contended=cont)
         t_lax, s_lax = run(env_var, "0", inst, reps)
         t_k, s_k = run(env_var, "1", inst, reps)
