@@ -413,18 +413,27 @@ def run_features(machines: int, rounds: int) -> dict:
         _, m = planner.schedule_round()
         lat.append(time.perf_counter() - t0)
         submit_population(state, tasks // 100, 16, seed=r + 1)  # churn
-    violations = sum(
-        1 for uid, is_zoned in zoned.items()
-        if is_zoned and (t := state.tasks.get(uid)) is not None
-        and t.scheduled_to is not None
-        and state.machines[t.scheduled_to].labels.get("zone") != "z1"
-    )
+    violations = zoned_placed = 0
+    for uid, is_zoned in zoned.items():
+        if not is_zoned:
+            continue
+        t = state.tasks.get(uid)
+        if t is None or t.scheduled_to is None:
+            continue
+        zoned_placed += 1
+        if state.machines[t.scheduled_to].labels.get("zone") != "z1":
+            violations += 1
+    n_zoned = sum(zoned.values())
     out["selectors"] = {
         "round_p50_s": (
             round(float(np.percentile(lat, 50)), 4) if lat else 0.0
         ),
         "violations": violations,
-        "placed": m.placed if m is not None else 0,
+        # Positive predicate too: zero violations with zero placements
+        # would be a vacuous pass (capacity holds them all, so all must
+        # place).
+        "zoned_placed": zoned_placed,
+        "zoned_total": n_zoned,
     }
     # Partial line per completed stage (the parent salvages these on a
     # timeout, same contract as the rung/trace children).
@@ -440,10 +449,16 @@ def run_features(machines: int, rounds: int) -> dict:
         ))
     n_targets = machines // 10
     for i in range(n_targets):
+        # Anti-affinity to their shared role spreads targets one per
+        # machine (without it, 100 identical-cost targets pack onto ~2
+        # machines whose task slots then can't hold any follower —
+        # measured at 1000 machines: 28/100 co-located, all failures
+        # slot-capacity, not affinity).
         state.task_submitted(TaskInfo(
             uid=task_uid("aff-db", i), job_id="aff-db",
             cpu_request=500, ram_request=1 << 19,
-            labels={"app": f"db{i}"},
+            labels={"app": f"db{i}", "role": "db"},
+            pod_anti_affinity=((IN_SET, "role", ("db",)),),
         ))
     planner = RoundPlanner(state, get_cost_model("cpu_mem"))
     planner.schedule_round()  # targets land and RUN
@@ -497,13 +512,15 @@ def run_features(machines: int, rounds: int) -> dict:
     t0 = time.perf_counter()
     _, mg = planner.schedule_round()
     gang_s = time.perf_counter() - t0
-    partial_gangs = 0
+    partial_gangs = placed_gangs = 0
     for g in range(n_gangs):
         placed_n = sum(
             1 for i in range(gang_size)
             if state.tasks[task_uid(f"gang{g}", i)].scheduled_to
         )
-        if 0 < placed_n < gang_size:
+        if placed_n == gang_size:
+            placed_gangs += 1
+        elif placed_n > 0:
             partial_gangs += 1
     big_placed = sum(
         1 for i in range(big)
@@ -512,12 +529,15 @@ def run_features(machines: int, rounds: int) -> dict:
     out["gang"] = {
         "round_s": round(gang_s, 4),
         "gangs": n_gangs,
+        "placed_gangs": placed_gangs,
         "partial_gangs": partial_gangs,
         "oversized_gang_placed": big_placed,
     }
     out["ok"] = (
         violations == 0
+        and zoned_placed == n_zoned        # selectors place AND respect
         and colocated == n_targets
+        and placed_gangs == n_gangs        # feasible gangs place WHOLE
         and partial_gangs == 0
         and big_placed == 0
     )
@@ -718,10 +738,13 @@ def main(argv=None) -> int:
     emit()  # a valid (empty-ladder) line exists before any child runs
     parity = _child("parity", [], PARITY_TIMEOUT_S)
     emit()
-    features = _child("features", [
-        "--machines", "1000", "--rounds", "3",
-    ], PARITY_TIMEOUT_S)
-    emit()
+    if not args.machines:
+        # Full-ladder mode only: single-config runs are quick focused
+        # smokes and must not pay an unrequested cluster-scale stage.
+        features = _child("features", [
+            "--machines", "1000", "--rounds", "3",
+        ], PARITY_TIMEOUT_S)
+        emit()
     for machines, tasks in ladder:
         res = _child("rung", [
             "--machines", str(machines), "--tasks", str(tasks),
