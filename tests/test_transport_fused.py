@@ -132,3 +132,25 @@ def test_fused_failure_degrades_to_lax(monkeypatch):
     monkeypatch.setenv("POSEIDON_FUSED", "0")
     ref = solve_transport(costs, supply, cap, unsched, arc_capacity=arc)
     assert sol.objective == ref.objective
+
+
+def test_fused_bit_parity_all_inadmissible(monkeypatch):
+    """Everything unscheduled: the fallback-arc-only path through the
+    kernel (every unit rides the EC->sink arc)."""
+    E, M = 8, 128
+    costs = np.full((E, M), transport.INF_COST, dtype=np.int32)
+    supply = np.arange(1, E + 1, dtype=np.int32)
+    cap = np.full(M, 4, np.int32)
+    unsched = np.full(E, 1500, np.int32)
+    a, b = _solve_both(monkeypatch, costs, supply, cap, unsched)
+    _assert_bit_equal(a, b)
+    assert (a.unsched == supply).all()
+
+
+def test_fused_bit_parity_zero_supply_rows(monkeypatch):
+    costs, supply, cap, unsched, arc = _instance(8, 128, 21)
+    supply[::2] = 0
+    a, b = _solve_both(
+        monkeypatch, costs, supply, cap, unsched, arc_capacity=arc
+    )
+    _assert_bit_equal(a, b)

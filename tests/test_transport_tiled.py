@@ -141,3 +141,25 @@ def test_use_tiled_gate(monkeypatch):
     # The broken latch wins over the force flag.
     monkeypatch.setattr(transport, "_TILED_BROKEN", True)
     assert not transport._use_tiled(256, 10240)
+
+
+def test_tiled_bit_parity_all_inadmissible(monkeypatch, small_tiles):
+    E, M = 8, 260  # 3 tiles at the test tile width
+    costs = np.full((E, M), transport.INF_COST, dtype=np.int32)
+    supply = np.arange(1, E + 1, dtype=np.int32)
+    cap = np.full(M, 4, np.int32)
+    unsched = np.full(E, 1500, np.int32)
+    a, b = _solve_both(monkeypatch, small_tiles, costs, supply, cap,
+                       unsched)
+    _assert_bit_equal(a, b)
+    assert (a.unsched == supply).all()
+
+
+def test_tiled_bit_parity_zero_supply_rows(monkeypatch, small_tiles):
+    costs, supply, cap, unsched, arc = _instance(8, 260, 23)
+    supply[::2] = 0
+    a, b = _solve_both(
+        monkeypatch, small_tiles, costs, supply, cap, unsched,
+        arc_capacity=arc,
+    )
+    _assert_bit_equal(a, b)
