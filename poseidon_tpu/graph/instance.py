@@ -620,6 +620,82 @@ class RoundPlanner:
         band = np.floor(-np.log(frac) / np.log(self.BAND_BASE))
         return np.clip(band, 0, self.NUM_BANDS - 1).astype(np.int64)
 
+    def _next_band_group(self, remaining, bands, ecs, mt,
+                         committed_cpu, committed_ram, committed_net):
+        """Greedily merge the next size bands into one solve while
+        capacity slack makes it safe.  Returns ``(n_bands, idx)`` — how
+        many leading entries of ``remaining`` the group takes, and their
+        EC row indices.
+
+        Why merge at all: on a tunneled accelerator every dispatch pays
+        a fixed host<->device round trip, so sequential band solves
+        multiply the round's latency floor; and a merged solve is
+        jointly MORE optimal than largest-first commitment (the ladder
+        is the approximation, not the merge).  Why a gate: within one
+        solve, capacity is denominated in the largest admissible request
+        per column, so a band spanning big and small tasks strands up to
+        a max/min-request factor of each machine's capacity.  The merge
+        is therefore allowed only while the group's crude LOWER bound on
+        capacity units (free // group-max request, summed over machines,
+        min over CPU/RAM/net dimensions) still covers twice the group's
+        supply — under that slack, stranding cannot cause unscheduled
+        tasks, and the per-column denominators inside the solve recover
+        most of it anyway.  Under tightness the gate closes and the
+        ladder behaves exactly as before (largest-first, per-band
+        denominators).
+
+        Called once per group from _solve_banded's loop, AGAINST THE
+        LIVE committed arrays — the slack seen by group k+1 reflects
+        everything groups 1..k committed this round.
+        """
+        cpu_free = np.maximum(
+            mt.cpu_capacity.astype(np.int64) - committed_cpu, 0
+        )
+        ram_free = np.maximum(
+            mt.ram_capacity.astype(np.int64) - committed_ram, 0
+        )
+        net_raw = (
+            mt.net_rx_capacity.astype(np.int64)
+            if mt.net_rx_capacity is not None else None
+        )
+        net_req_all = ecs.net_rx().astype(np.int64)
+
+        idx = np.nonzero(bands == remaining[0])[0]
+        g_supply = int(ecs.supply[idx].sum())
+        g_max_cpu = int(ecs.cpu_request[idx].max(initial=0))
+        g_max_ram = int(ecs.ram_request[idx].max(initial=0))
+        g_max_net = int(net_req_all[idx].max(initial=0))
+        n = 1
+        for band in remaining[1:]:
+            b_idx = np.nonzero(bands == band)[0]
+            max_cpu = max(g_max_cpu, int(ecs.cpu_request[b_idx].max(
+                initial=0)))
+            max_ram = max(g_max_ram, int(ecs.ram_request[b_idx].max(
+                initial=0)))
+            max_net = max(g_max_net, int(net_req_all[b_idx].max(
+                initial=0)))
+            supply = g_supply + int(ecs.supply[b_idx].sum())
+            units = np.minimum(
+                cpu_free // max(max_cpu, 1),
+                ram_free // max(max_ram, 1),
+            )
+            if net_raw is not None and max_net > 0:
+                net_free = np.maximum(net_raw - committed_net, 0)
+                units = np.minimum(
+                    units,
+                    # Machines with no accounted NIC capacity (raw 0)
+                    # are net-unconstrained, as in the band solve.
+                    np.where(net_raw > 0, net_free // max_net,
+                             units),
+                )
+            if int(units.sum()) < 2 * supply:
+                break
+            idx = np.concatenate([idx, b_idx])
+            g_supply = supply
+            g_max_cpu, g_max_ram, g_max_net = max_cpu, max_ram, max_net
+            n += 1
+        return n, np.sort(idx)
+
     def _solve_banded(self, ecs, mt, metrics) -> np.ndarray:
         """The round's solve: size-banded transportation with committed
         resources flowing between bands.
@@ -664,8 +740,14 @@ class RoundPlanner:
         objective = 0
         gap = 0.0
         iters = 0
-        for band in sorted(set(bands.tolist())):
-            idx = np.nonzero(bands == band)[0]
+        remaining = sorted(set(bands.tolist()))
+        while remaining:
+            n_bands, idx = self._next_band_group(
+                remaining, bands, ecs, mt, committed_cpu, committed_ram,
+                committed_net,
+            )
+            band = int(remaining[0])  # warm-frame key: group's largest
+            remaining = remaining[n_bands:]
             ecs_b = _slice_ecs(ecs, idx)
             mt_b = _with_usage(
                 mt, committed_cpu, committed_ram, committed_net,
