@@ -615,6 +615,25 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
 _FUSED_BROKEN = False
 _TILED_BROKEN = False
 
+# Platforms where device-side fixed costs (kernel launches, loop-step
+# syncs, per-dispatch tunnel round trips) dominate small-array work —
+# the backends the Pallas kernels and dispatch-count policies target.
+ACCEL_PLATFORMS = ("tpu", "axon")
+
+
+def accel_policy(env_var: str) -> bool:
+    """Shared three-state accelerator-policy gate: the env var forces
+    on ("1") or off ("0"); unset defers to the backend (True on
+    ACCEL_PLATFORMS).  Used by the fused/tiled kernel gates and the
+    planner's band-merge policy — one definition so a platform-list
+    change cannot miss a site."""
+    env = os.environ.get(env_var, "")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    return jax.default_backend() in ACCEL_PLATFORMS
+
 
 def _use_tiled(e_pad: int, m_pad: int) -> bool:
     """Route this solve through the tiled per-iteration Pallas kernel?
@@ -627,14 +646,11 @@ def _use_tiled(e_pad: int, m_pad: int) -> bool:
     from poseidon_tpu.ops.transport_fused import fits_vmem
     from poseidon_tpu.ops.transport_tiled import fits_tile
 
-    env = os.environ.get("POSEIDON_TILED", "")
-    if env == "0" or _TILED_BROKEN:
+    if _TILED_BROKEN:
         return False
     if fits_vmem(e_pad, m_pad) or not fits_tile(e_pad):
         return False
-    if env == "1":
-        return True
-    return jax.default_backend() in ("tpu", "axon")
+    return accel_policy("POSEIDON_TILED")
 
 
 def _use_fused(e_pad: int, m_pad: int) -> bool:
@@ -648,17 +664,14 @@ def _use_fused(e_pad: int, m_pad: int) -> bool:
     """
     from poseidon_tpu.ops.transport_fused import fits_vmem
 
-    env = os.environ.get("POSEIDON_FUSED", "")
-    if env == "0" or _FUSED_BROKEN:
+    if _FUSED_BROKEN:
         return False
     if not fits_vmem(e_pad, m_pad):
         return False
-    if env == "1":
-        return True
-    # TPU backends only ("axon" is the tunneled TPU plugin): the kernel
-    # is Mosaic-lowered pltpu code — a GPU backend must keep the lax
-    # path rather than fail to lower.
-    return jax.default_backend() in ("tpu", "axon")
+    # ACCEL_PLATFORMS only ("axon" is the tunneled TPU plugin): the
+    # kernel is Mosaic-lowered pltpu code — a GPU backend must keep the
+    # lax path rather than fail to lower.
+    return accel_policy("POSEIDON_FUSED")
 
 
 # The epsilon ladder always has this many phases: values are traced (no

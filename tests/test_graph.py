@@ -387,7 +387,10 @@ class TestBandMerging:
         planner._next_band_group = one_band
         return orig
 
-    def test_slack_merges_to_one_dispatch_same_objective(self):
+    def test_slack_merges_to_one_dispatch_same_objective(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("POSEIDON_MERGE_BANDS", "1")
         # Plenty of slack (640 big-task units of CPU vs 220 tasks):
         # big and small bands merge into one dispatch.
         st1 = self._mixed_state(40, 32, 20, 200, cpu_cap=64000)
@@ -402,7 +405,8 @@ class TestBandMerging:
         assert m1.objective <= m2.objective  # joint solve >= as good
         assert m1.converged and m2.converged
 
-    def test_tight_capacity_keeps_per_band_ladder(self):
+    def test_tight_capacity_keeps_per_band_ladder(self, monkeypatch):
+        monkeypatch.setenv("POSEIDON_MERGE_BANDS", "1")
         # Demand ~= capacity in units of the big request: the gate must
         # close and behave exactly like the old per-band ladder.
         st1 = self._mixed_state(6, 4, 20, 60, cpu_cap=8000)
@@ -416,10 +420,11 @@ class TestBandMerging:
         assert m1.objective == m2.objective
         assert m1.unscheduled == m2.unscheduled
 
-    def test_merge_gate_sees_live_commitments(self):
+    def test_merge_gate_sees_live_commitments(self, monkeypatch):
         """The slack seen by group k+1 must reflect what groups 1..k
         committed THIS round (a stale pre-round snapshot would merge
         bands the committed capacity can no longer hold)."""
+        monkeypatch.setenv("POSEIDON_MERGE_BANDS", "1")
         import numpy as np
 
         st = self._mixed_state(4, 64, 14, 40, cpu_cap=16000)
@@ -439,3 +444,12 @@ class TestBandMerging:
         if len(seen_units) > 1:
             # Later gate calls observed strictly less free CPU.
             assert seen_units[1] < seen_units[0]
+
+    def test_cpu_backend_defaults_to_per_band(self, monkeypatch):
+        """On CPU (dispatches ~free) merging is off by default: the
+        measured trade reverses at 10k scale (see _next_band_group)."""
+        monkeypatch.delenv("POSEIDON_MERGE_BANDS", raising=False)
+        st = self._mixed_state(40, 32, 20, 200, cpu_cap=64000)
+        planner = RoundPlanner(st, CpuMemCostModel())
+        _, m = planner.schedule_round()
+        assert m.device_calls >= 2  # one dispatch per band, as before
