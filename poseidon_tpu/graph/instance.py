@@ -553,8 +553,12 @@ class RoundPlanner:
         placements was measured net-harmful: load-shaped costs move
         between rounds, so the prior assignment certifies worse than a
         fresh greedy — 217-300 iterations vs 0 at 1k/10k churn.)
-        Entries are consumed (popped) — a one-shot hint, so the dict
-        cannot pin dead uids."""
+        Entries are consumed (popped) only when their machine column
+        RESOLVES in this round's view; a hint whose machine is absent
+        stays for a later round (the FIFO cap bounds growth), and the
+        assignment pass re-inserts hints for members that end the round
+        still unplaced — a churned task that misses placement in the
+        following round must not permanently lose its locality."""
         self._round_prior = None
         prior = self.state.prior_machine
         if not (self.incremental and prior):
@@ -586,12 +590,15 @@ class RoundPlanner:
                         )
                     cand = cand[np.isin(uids[cand], keys)]
                 for j in cand.tolist():
-                    m = prior.pop(int(uids[j]), None)
-                    if m is not None:
-                        c = col_of.get(m, -1)
+                    uid = int(uids[j])
+                    m = prior.get(uid)
+                    if m is None:
+                        continue
+                    c = col_of.get(m, -1)
+                    if c >= 0:
+                        prior.pop(uid)
                         cols[j] = c
-                        if c >= 0:
-                            found += 1
+                        found += 1
         if found:
             self._round_prior = per_ec
 
@@ -1113,6 +1120,25 @@ class RoundPlanner:
                 k = min(chosen.size, cols_exp.size)
                 if k:
                     new_col[chosen[:k]] = cols_exp[:k]
+            if self._round_prior is not None:
+                # Hints consumed by _collect_prior but not applied to a
+                # member that ends the round UNPLACED (lost the
+                # wait-ordered tie-break, or the prior machine received
+                # no flow) go back into the state dict: one-shot consume
+                # is only for hints actually used.  Members placed
+                # elsewhere drop theirs — the new machine supersedes it
+                # on the next removal.
+                pcols = self._round_prior[i]
+                unapplied = np.nonzero((pcols >= 0) & (new_col < 0))[0]
+                if unapplied.size:
+                    with self.state._lock:
+                        pm = self.state.prior_machine
+                        for j in unapplied.tolist():
+                            uid = int(uids[j])
+                            pm.pop(uid, None)  # refresh FIFO position
+                            pm[uid] = uuids[int(pcols[j])]
+                        while len(pm) > self.state._PRIOR_CAP:
+                            pm.pop(next(iter(pm)))
 
             # Pass 3: diff -> deltas; only changed tasks touch Python.
             if not self.preemption:

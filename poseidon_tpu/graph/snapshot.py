@@ -111,8 +111,26 @@ def _atomic_write(path: Path, data: bytes) -> None:
     import os
 
     tmp = path.with_name(path.name + ".tmp")
-    tmp.write_bytes(data)
+    fd = os.open(tmp, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o644)
+    try:
+        view = memoryview(data)
+        while view:  # os.write may write short (and caps at ~2GB/call)
+            view = view[os.write(fd, view):]
+        # Without the fsync, a power loss can persist the rename but not
+        # the data blocks — an empty checkpoint where "degrade to fresh
+        # start" silently discards everything the checkpoint existed for.
+        os.fsync(fd)
+    finally:
+        os.close(fd)
     os.replace(tmp, path)
+    try:
+        dfd = os.open(path.parent, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # directory fsync is best-effort (not all FS allow it)
 
 
 def load_state(path: Union[str, Path],
@@ -160,6 +178,46 @@ def load_state(path: Union[str, Path],
     return state
 
 
+def serialize_checkpoint(state: ClusterState, planner):
+    """Capture a consistent ``(state_bytes, frames_bytes | None)`` pair.
+
+    Split from the disk write so a caller holding a scheduling lock can
+    release it before paying the fsync latency: only the serialization
+    needs the consistent view, the durable write does not.
+    """
+    import numpy as np
+
+    with state._lock:
+        doc = {
+            "version": _FORMAT_VERSION,
+            "round_index": state.round_index,
+            "machines": [
+                _machine_to_dict(m) for m in state.machines.values()
+            ],
+            "tasks": [_task_to_dict(t) for t in state.tasks.values()],
+        }
+        frames = planner.export_warm_state()
+    state_bytes = json.dumps(doc).encode()
+    if frames:
+        import io
+
+        buf = io.BytesIO()
+        np.savez_compressed(buf, **frames)
+        return state_bytes, buf.getvalue()
+    return state_bytes, None
+
+
+def write_checkpoint(path: Union[str, Path], state_bytes: bytes,
+                     frames_bytes) -> None:
+    """Durably install serialized checkpoint bytes (atomic + fsync)."""
+    _atomic_write(Path(path), state_bytes)
+    warm_path = Path(str(path) + ".warm.npz")
+    if frames_bytes is not None:
+        _atomic_write(warm_path, frames_bytes)
+    elif warm_path.exists():
+        warm_path.unlink()  # stale frames must not outlive their state
+
+
 def save_checkpoint(state: ClusterState, planner, path: Union[str, Path]):
     """Full service checkpoint: cluster state (JSON) + the planner's
     solver warm frames (compressed npz at ``<path>.warm.npz``).
@@ -170,19 +228,7 @@ def save_checkpoint(state: ClusterState, planner, path: Union[str, Path]):
     10k scale), while a restored frame solves the unchanged backlog at
     the drift-epsilon floor in near-zero iterations.
     """
-    import numpy as np
-
-    save_state(state, path)
-    frames = planner.export_warm_state()
-    warm_path = Path(str(path) + ".warm.npz")
-    if frames:
-        import io
-
-        buf = io.BytesIO()
-        np.savez_compressed(buf, **frames)
-        _atomic_write(warm_path, buf.getvalue())
-    elif warm_path.exists():
-        warm_path.unlink()  # stale frames must not outlive their state
+    write_checkpoint(path, *serialize_checkpoint(state, planner))
 
 
 def load_checkpoint(path: Union[str, Path], cost_model=None,

@@ -609,11 +609,12 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
     return F, Ffb, prices, iters, bf, clean, phase_iters
 
 
-# Latched True after the first Mosaic-lowering failure of the fused /
-# tiled kernels on this process's backend (see solve_transport's
-# fallback).
-_FUSED_BROKEN = False
-_TILED_BROKEN = False
+# Padded shapes (E_pad, M_pad) whose fused / tiled Mosaic lowering failed
+# on this process's backend (see solve_transport's fallback).  Per-shape,
+# not global: a VMEM overflow at one edge shape must not disable the
+# kernel for every shape it serves fine.
+_FUSED_BROKEN: set = set()
+_TILED_BROKEN: set = set()
 
 # Platforms where device-side fixed costs (kernel launches, loop-step
 # syncs, per-dispatch tunnel round trips) dominate small-array work —
@@ -646,7 +647,7 @@ def _use_tiled(e_pad: int, m_pad: int) -> bool:
     from poseidon_tpu.ops.transport_fused import fits_vmem
     from poseidon_tpu.ops.transport_tiled import fits_tile
 
-    if _TILED_BROKEN:
+    if (e_pad, m_pad) in _TILED_BROKEN:
         return False
     if fits_vmem(e_pad, m_pad) or not fits_tile(e_pad):
         return False
@@ -664,7 +665,7 @@ def _use_fused(e_pad: int, m_pad: int) -> bool:
     """
     from poseidon_tpu.ops.transport_fused import fits_vmem
 
-    if _FUSED_BROKEN:
+    if (e_pad, m_pad) in _FUSED_BROKEN:
         return False
     if not fits_vmem(e_pad, m_pad):
         return False
@@ -1214,8 +1215,9 @@ def solve_transport(
     def _try_pallas(solve_fn, kernel_name, latch_name):
         # A backend whose Mosaic lowering rejects a kernel must degrade
         # to the (mathematically identical) lax path, not fail solves.
-        # Once broken, stay off: the error is per-program, not
-        # per-instance.
+        # Once broken, stay off FOR THIS SHAPE: Pallas programs compile
+        # per padded shape, so one shape's lowering failure (e.g. VMEM
+        # overflow at an alignment edge) says nothing about the others.
         try:
             return solve_fn(
                 *operands, max_iter=max_iter_per_phase, scale=int(scale),
@@ -1225,12 +1227,13 @@ def solve_transport(
                 interpret=jax.default_backend() == "cpu",
             )
         except Exception as e:  # noqa: BLE001 - availability over speed
-            globals()[latch_name] = True
+            globals()[latch_name].add((E_pad, M_pad))
             import logging
 
             logging.getLogger("poseidon_tpu.transport").error(
-                "%s Pallas kernel unavailable on this backend (%s: %s); "
-                "using the lax path", kernel_name, type(e).__name__, e,
+                "%s Pallas kernel unavailable for shape [%d, %d] on this "
+                "backend (%s: %s); using the lax path", kernel_name,
+                E_pad, M_pad, type(e).__name__, e,
             )
             return None
 

@@ -51,7 +51,12 @@ VMEM_ELEM_BUDGET = 1 << 18
 
 
 def fits_vmem(e_pad: int, m_pad: int) -> bool:
-    return e_pad * m_pad <= VMEM_ELEM_BUDGET
+    # Budget the ALIGNED operand shape (_kernel_shape re-pads rows to 8
+    # and lanes to 128): quarter-octave widths like 320 inflate ~1.2-1.5x
+    # past the raw e_pad*m_pad, and a VMEM overflow at such an edge shape
+    # would latch the kernel off for shapes it serves fine.
+    ek, mk = _kernel_shape(e_pad, m_pad)
+    return ek * mk <= VMEM_ELEM_BUDGET
 
 
 def _kernel_shape(e_pad: int, m_pad: int):

@@ -93,6 +93,10 @@ class FirmamentServicer:
         # is single-writer (the reference client also calls Schedule from
         # one loop, cmd/poseidon/poseidon.go:32-72).
         self._schedule_lock = threading.Lock()
+        # Checkpoint writes happen OUTSIDE the schedule lock (fsync
+        # latency must not stall rounds) but must still not interleave
+        # with each other (periodic vs shutdown save share a tmp path).
+        self._ckpt_write_lock = threading.Lock()
         self._precompiled = False
 
     # ------------------------------------------------------------- scheduling
@@ -144,14 +148,24 @@ class FirmamentServicer:
         another's frames."""
         if not self.config.checkpoint_path:
             return
-        from poseidon_tpu.graph.snapshot import save_checkpoint
+        from poseidon_tpu.graph.snapshot import (
+            serialize_checkpoint,
+            write_checkpoint,
+        )
 
         try:
+            # Serialize under the lock (consistency), write + fsync
+            # OUTSIDE it: durable-write latency on a slow checkpoint disk
+            # must not stall concurrent Schedule RPCs.
             with self._schedule_lock:
-                save_checkpoint(
-                    self.state, self.planner, self.config.checkpoint_path
-                )
-        except OSError as e:
+                payload = serialize_checkpoint(self.state, self.planner)
+            with self._ckpt_write_lock:
+                write_checkpoint(self.config.checkpoint_path, *payload)
+        except Exception as e:  # noqa: BLE001 - never-fatal by contract:
+            # snapshot serialization can raise beyond OSError (np.savez
+            # ValueError, json TypeError), and in the periodic path this
+            # runs AFTER schedule_round mutated state — propagating would
+            # fail the RPC and desync the client from committed placements.
             log.error("checkpoint write failed: %s", e)
 
     # ----------------------------------------------------------- task lifecycle

@@ -277,3 +277,44 @@ def test_affinity_never_starves_longest_waiter():
     _, m = planner.schedule_round()
     assert state.tasks[waiter].scheduled_to == "st-m0"
     assert state.tasks[occ].scheduled_to is None
+    # The consumed-but-unapplied hint went BACK into the dict (losing
+    # one round's tie-break must not permanently lose locality), and it
+    # still works: when the waiter departs, the occupant goes home.
+    assert state.prior_machine.get(occ) == "st-m0"
+    state.task_removed(waiter)
+    planner.schedule_round()
+    assert state.tasks[occ].scheduled_to == "st-m0"
+    assert occ not in state.prior_machine  # applied -> consumed
+
+
+def test_affinity_hint_not_consumed_when_machine_absent():
+    """A hint whose prior machine is missing from the round view stays in
+    the dict (the FIFO cap bounds growth) instead of being popped
+    uselessly — it becomes usable again if the machine returns."""
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+    from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+    from poseidon_tpu.utils.ids import task_uid
+
+    state = ClusterState()
+    for name in ("ab-m0", "ab-m1"):
+        state.node_added(MachineInfo(
+            uuid=name, cpu_capacity=8000, ram_capacity=1 << 24,
+            task_slots=4,
+        ))
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+    uid = task_uid("ab", 0)
+    state.task_submitted(TaskInfo(uid=uid, job_id="j", cpu_request=100,
+                                  ram_request=1 << 18))
+    planner.schedule_round()
+    home = state.tasks[uid].scheduled_to
+    state.task_removed(uid)
+    assert state.prior_machine[uid] == home
+    state.node_removed(home)
+    state.task_submitted(TaskInfo(uid=uid, job_id="j", cpu_request=100,
+                                  ram_request=1 << 18))
+    planner.schedule_round()
+    # Placed on the surviving machine; the unusable hint was NOT popped.
+    assert state.tasks[uid].scheduled_to is not None
+    assert state.tasks[uid].scheduled_to != home
+    assert state.prior_machine.get(uid) == home

@@ -124,7 +124,7 @@ def test_fused_failure_degrades_to_lax(monkeypatch):
 
     monkeypatch.setenv("POSEIDON_FUSED", "1")
     monkeypatch.setattr(TF, "solve_device_fused", boom)
-    monkeypatch.setattr(T, "_FUSED_BROKEN", False)
+    monkeypatch.setattr(T, "_FUSED_BROKEN", set())
     costs, supply, cap, unsched, arc = _instance(12, 64, 3)
     sol = solve_transport(costs, supply, cap, unsched, arc_capacity=arc)
     assert sol.gap_bound == 0.0

@@ -129,7 +129,7 @@ def test_use_tiled_gate(monkeypatch):
     monkeypatch.setattr(transport_fused, "VMEM_ELEM_BUDGET", 1 << 18)
     monkeypatch.setattr(transport_tiled, "TILE_W", 512)
     monkeypatch.delenv("POSEIDON_TILED", raising=False)
-    monkeypatch.setattr(transport, "_TILED_BROKEN", False)
+    monkeypatch.setattr(transport, "_TILED_BROKEN", set())
     # CPU backend: off by default.
     assert not transport._use_tiled(256, 10240)
     monkeypatch.setenv("POSEIDON_TILED", "1")
@@ -139,7 +139,7 @@ def test_use_tiled_gate(monkeypatch):
     # Row-bound: a column tile's working set must fit.
     assert not transport._use_tiled(1024, 10240)
     # The broken latch wins over the force flag.
-    monkeypatch.setattr(transport, "_TILED_BROKEN", True)
+    monkeypatch.setattr(transport, "_TILED_BROKEN", {(256, 10240)})
     assert not transport._use_tiled(256, 10240)
 
 
