@@ -6,11 +6,19 @@ reference publishes no numbers of its own (its default round *interval*
 is 10 s, pkg/config/config.go:120); the 1 s round target is the baseline
 ``vs_baseline`` is computed against (>1.0 = beating it).
 
-Structure: a scale LADDER (1k -> 2k -> 4k -> 10k machines, 10 pods per
-machine).  Every rung runs in a subprocess with a timeout, so a worker
-crash or a wedged accelerator tunnel degrades the report instead of
-zeroing it — the parent process never touches jax and ALWAYS emits the
-final JSON line, scored on the largest completed rung.
+Structure: a scale LADDER run NORTH-STAR-FIRST (10k machines, then
+1k -> 2k -> 4k for the scaling table; 10 pods per machine).  Every rung
+runs in a subprocess with a timeout, so a worker crash or a wedged
+accelerator tunnel degrades the report instead of zeroing it — the
+parent process never touches jax and ALWAYS emits the final JSON line.
+The backend is probed ONCE, in the parent, before any child runs: a dead
+tunnel costs one probe timeout for the whole bench, not one per child,
+and the verdict (live accelerator, or latched clean-CPU environment) is
+exported to every child via POSEIDON_BENCH_NO_PROBE (round-4 review: 7
+children x 300 s of re-probing a known-dead tunnel consumed the outer
+budget that the 10k/100k rung needed).  On a live backend the parent
+holds the host-wide device flock for the whole run; children inherit
+serialization by running sequentially under it.
 
 Three honest numbers per rung (round-2 review: a drain-and-resubmit-
 identical wave measures only the bit-identical warm cache):
@@ -39,9 +47,14 @@ still leaves a valid, maximal artifact on stdout)::
    "parity_ok": true, "trace": {...config-5 replay...},
    "ladder": [...per-rung results/errors...]}
 
-``value`` is the fresh-population WAVE p50 at the largest completed rung
-— the north-star config's own number (100k pods pending at once);
-``churn_p50_s`` reports the steady-state latency alongside it.
+``value`` is the fresh-population WAVE p50 at the NORTH-STAR config
+(10k machines / 100k pods pending at once) and ONLY that config: a
+missing or unconverged 10k rung posts ``vs_baseline: 0`` (round-4
+review: "largest completed rung" scoring let a bench that timed out
+earlier post a better-looking score than an honest 10k completion).
+``churn_p50_s`` reports the steady-state latency alongside it and
+``restart_s`` the recovery-to-first-placement after a checkpoint
+restore at the same scale.
 """
 
 from __future__ import annotations
@@ -55,44 +68,74 @@ import time
 
 import numpy as np
 
-LADDER = [(1_000, 10_000), (2_000, 20_000), (4_000, 40_000),
-          (10_000, 100_000)]
+# North-star config FIRST: any budget squeeze (wedged tunnel, slow
+# backend, outer deadline) must cost the scaling-table rungs, never the
+# scored 10k/100k number (round-4 review: the ascending ladder made the
+# north-star rung the first casualty of every timeout).
+NORTH_STAR = (10_000, 100_000)
+LADDER = [NORTH_STAR, (1_000, 10_000), (2_000, 20_000), (4_000, 40_000)]
 RUNG_TIMEOUT_S = int(os.environ.get("POSEIDON_BENCH_RUNG_TIMEOUT", "1800"))
 PARITY_TIMEOUT_S = 600
+# BASELINE configs 2-4 (selectors/affinity/gang) run at cluster scale;
+# 4k machines needs more than the parity budget.
+FEATURES_TIMEOUT_S = int(
+    os.environ.get("POSEIDON_BENCH_FEATURES_TIMEOUT", "1200")
+)
 # Grace between SIGTERM and SIGKILL for a timed-out child: the child's
 # SIGTERM handler (install_graceful_term) exits after the in-flight
 # device op returns, so the grace must cover one worst-case device
 # program.  SIGKILL is the very last resort — killing a chip-holding
 # process mid-op wedges the tunnel for everyone.
 TERM_GRACE_S = int(os.environ.get("POSEIDON_BENCH_TERM_GRACE", "300"))
-# Pre-work allowance added to every child budget: a child may spend up to
-# the device-lock timeout waiting for another chip user plus the backend
-# probe before its measured work starts; charging that wait against the
-# rung/parity budget would SIGTERM a child that was merely queueing.
-PREWORK_S = (
-    0 if os.environ.get("POSEIDON_BENCH_NO_PROBE")
-    else int(float(os.environ.get("POSEIDON_DEVICE_LOCK_TIMEOUT", "600")))
-    + 300
-)
 
 
-def _ensure_live_backend() -> None:
-    """Probe the accelerator in a subprocess; fall back to CPU if dead.
+def _prework_allowance() -> int:
+    """Extra child budget for device-lock wait + backend probe.
+
+    Zero once a probe verdict is latched (POSEIDON_BENCH_NO_PROBE set by
+    the parent's single probe or the operator): children then start
+    their measured work immediately.  Evaluated at child-launch time —
+    the parent latches the verdict AFTER this module loads.
+    """
+    if os.environ.get("POSEIDON_BENCH_NO_PROBE"):
+        return 0
+    return int(float(os.environ.get("POSEIDON_DEVICE_LOCK_TIMEOUT", "600"))
+               ) + 300
+
+
+def _parent_probe_and_latch() -> None:
+    """Probe the accelerator ONCE, in the parent; latch the verdict for
+    every child.
 
     The TPU tunnel can wedge (worker crash leaves every op hanging
     forever).  A subprocess probe detects that without hanging this
-    process; the fallback re-execs with the accelerator plugin stripped
-    so the benchmark still reports a number (tagged via ``backend``).
-    The host-wide device lock is taken FIRST — concurrent backend init
-    across processes is itself a wedge trigger — and held for this
-    process's lifetime, covering the probe child and the rung itself.
+    process.  Verdicts:
+
+    - live: children run on the accelerator with no further probing; the
+      PARENT holds the host-wide device flock for the whole bench (the
+      children run sequentially under it, which is the serialization the
+      lock exists for — concurrent backend init is a wedge trigger);
+    - dead/busy: the parent's own environment is rewritten to the clean
+      CPU one, so every child inherits `backend: "cpu"` without spending
+      a single additional probe second on the dead tunnel.
     """
     if os.environ.get("POSEIDON_BENCH_NO_PROBE"):
-        return
+        return  # operator already latched a verdict (CPU dry-run mode)
     from poseidon_tpu.utils.envutil import (
         clean_cpu_env,
         serialize_device_access,
     )
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # Explicit CPU request: the env var alone is NOT enough when an
+        # accelerator-plugin site hook is present (it re-pins the
+        # platform and its client init hangs on a dead tunnel even for
+        # CPU-pinned children) — latch the CLEAN cpu env, probe nothing.
+        env = clean_cpu_env(os.path.dirname(os.path.abspath(__file__)))
+        env["POSEIDON_BENCH_NO_PROBE"] = "1"
+        os.environ.clear()
+        os.environ.update(env)
+        return
 
     locked = serialize_device_access()  # $POSEIDON_DEVICE_LOCK_TIMEOUT
     if locked:
@@ -114,11 +157,54 @@ def _ensure_live_backend() -> None:
               file=sys.stderr)
         ok = False
     if ok:
+        os.environ["POSEIDON_BENCH_NO_PROBE"] = "1"
+        print("# accelerator probe ok; children skip probing",
+              file=sys.stderr)
         return
     env = clean_cpu_env(os.path.dirname(os.path.abspath(__file__)))
     env["POSEIDON_BENCH_NO_PROBE"] = "1"
-    print("# accelerator unreachable; falling back to CPU", file=sys.stderr)
-    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    print("# accelerator unreachable; latching CPU for all children",
+          file=sys.stderr)
+    os.environ.clear()
+    os.environ.update(env)
+    # This process will never touch the chip again: holding the
+    # exclusive flock through an hours-long CPU ladder would block any
+    # recovered tunnel's real users (service, tools) behind a bench
+    # that no longer wants the hardware.
+    from poseidon_tpu.utils.envutil import release_device_lock
+
+    release_device_lock()
+
+
+def _ensure_live_backend() -> None:
+    """Child-side backend guard.
+
+    Under the parent driver this is a no-op: the parent probed once and
+    latched the verdict into the environment.  Only a MANUALLY invoked
+    child (``bench.py --child rung ...`` for triage) still probes here,
+    re-exec'ing itself onto the clean CPU environment when the
+    accelerator is dead — same semantics the parent applies, in process-
+    replacement form because jax may already be importable.
+    """
+    if os.environ.get("POSEIDON_BENCH_NO_PROBE"):
+        return
+    before = dict(os.environ)
+    _parent_probe_and_latch()
+
+    def _sans_latch(env):
+        return {k: v for k, v in env.items()
+                if k != "POSEIDON_BENCH_NO_PROBE"}
+
+    if _sans_latch(dict(os.environ)) != _sans_latch(before):
+        # The latch rewrote the environment (CPU pin, plugin strip,
+        # PYTHONPATH rewrite — any of them): restart on it.  Env edits
+        # cannot undo the plugin's already-installed import hooks in
+        # THIS interpreter, whose first jax op would still hang on a
+        # dead tunnel.  The live-verdict path sets only the latch flag
+        # and keeps running here (an execve would drop the held device
+        # flock: the fd is close-on-exec).
+        os.execve(sys.executable, [sys.executable] + sys.argv,
+                  dict(os.environ))
 
 
 def _task_population(num_tasks: int, num_ecs: int, seed: int):
@@ -583,7 +669,7 @@ def _child(mode: str, argv: list, timeout: int) -> dict:
                                 stderr=subprocess.PIPE, text=True)
         timed_out = False
         try:
-            out, err = proc.communicate(timeout=timeout + PREWORK_S)
+            out, err = proc.communicate(timeout=timeout + _prework_allowance())
         except subprocess.TimeoutExpired:
             timed_out = True
             proc.terminate()
@@ -670,29 +756,37 @@ def main(argv=None) -> int:
         print(json.dumps(run_features(args.machines, args.rounds)))
         return 0
 
-    # ---- parent: drive the stages; never touches jax, and re-emits the
-    # running JSON line after EVERY stage, so even if this process is
-    # killed mid-ladder the last line on stdout is a valid artifact for
-    # everything completed so far (a line-scanning consumer takes the
-    # final line; each line is a superset of the previous one).
+    # ---- parent: drive the stages; never touches jax (the probe runs in
+    # a disposable subprocess), and re-emits the running JSON line after
+    # EVERY stage, so even if this process is killed mid-ladder the last
+    # line on stdout is a valid artifact for everything completed so far
+    # (a line-scanning consumer takes the final line; each line is a
+    # superset of the previous one).
+    _parent_probe_and_latch()
     ladder = LADDER
+    target = NORTH_STAR
     if args.machines:
         ladder = [(args.machines, args.tasks or 10 * args.machines)]
+        target = ladder[0]
     rungs = []
     parity = {"ok": False, "error": "not run"}
     trace = {"ok": False, "error": "not run"}
     features = {"ok": False, "error": "not run"}
 
     def emit():
+        # Score ONLY the target config (the north star, or the requested
+        # config in single-config mode): a bench that loses rungs to a
+        # timeout must post a WORSE artifact, never a better-looking one.
         best = None
         for r in rungs:
-            if r.get("ok"):
+            if (r.get("ok")
+                    and (r.get("machines"), r.get("tasks")) == target):
                 best = r
         out = {
             "metric": "schedule_round_s",
             "unit": "s",
-            "target_machines": 10_000,
-            "target_tasks": 100_000,
+            "target_machines": target[0],
+            "target_tasks": target[1],
             # Parity failure and parity-harness failure are different
             # triage paths: surface the whole child result, not the bit.
             "parity_ok": parity.get("parity_ok", False),
@@ -706,18 +800,16 @@ def main(argv=None) -> int:
         }
         if best is None:
             out.update({"value": None, "vs_baseline": 0.0,
-                        "error": "no ladder rung completed"})
+                        "error": f"target rung {target[0]}/{target[1]} "
+                                 "not completed"})
         else:
-            # Headline: the NORTH-STAR config — a full pending wave at the
-            # largest completed rung (BASELINE.md: "10k nodes / 100k
-            # pending pods round < 1 s").  Steady-state churn p50 is
-            # reported alongside (the latency a production cluster pays
-            # every round) but does not set the score: round-3 review
-            # called scoring churn while the target sentence is the wave
-            # a 9x flattering of the headline.  An unconverged rung posts
-            # no vs_baseline: budget-exhausted solves return fast but
-            # commit uncertified placements, and claiming a win on them
-            # would be dishonest.
+            # Headline: a full pending wave at the north-star config
+            # (BASELINE.md: "10k nodes / 100k pending pods round < 1 s").
+            # Steady-state churn p50 is reported alongside (the latency a
+            # production cluster pays every round) but does not set the
+            # score.  An unconverged rung posts no vs_baseline: budget-
+            # exhausted solves return fast but commit uncertified
+            # placements, and claiming a win on them would be dishonest.
             value = best["wave_p50_s"]
             honest = bool(best.get("converged"))
             out.update({
@@ -732,20 +824,14 @@ def main(argv=None) -> int:
                 "cold_s": best["cold_s"],
                 "wave_p50_s": best["wave_p50_s"],
                 "churn_p50_s": best["churn_p50_s"],
+                # Recovery-to-first-placement after a checkpoint restore
+                # at the scored scale (the warm frames ride the
+                # checkpoint; the reference has no counterpart).
+                "restart_s": best.get("restart_round_s"),
             })
         print(json.dumps(out), flush=True)
 
-    emit()  # a valid (empty-ladder) line exists before any child runs
-    parity = _child("parity", [], PARITY_TIMEOUT_S)
-    emit()
-    if not args.machines:
-        # Full-ladder mode only: single-config runs are quick focused
-        # smokes and must not pay an unrequested cluster-scale stage.
-        features = _child("features", [
-            "--machines", "1000", "--rounds", "3",
-        ], PARITY_TIMEOUT_S)
-        emit()
-    for machines, tasks in ladder:
+    def run_rung_child(machines, tasks):
         res = _child("rung", [
             "--machines", str(machines), "--tasks", str(tasks),
             "--ecs", str(args.ecs), "--rounds", str(args.rounds),
@@ -756,21 +842,44 @@ def main(argv=None) -> int:
         emit()
         if not res.get("ok"):
             print(f"# rung {machines}/{tasks} failed: "
-                  f"{res.get('error')}; stopping ladder", file=sys.stderr)
-            break
+                  f"{res.get('error')}; continuing with remaining rungs",
+                  file=sys.stderr)
+        return res
 
-    # Trace replay (BASELINE config 5) at the largest completed rung's
-    # scale: realistic job churn with incremental re-solve.
-    trace = {"ok": False, "error": "no completed rung to size the trace"}
-    for r in reversed(rungs):
-        if r.get("ok"):
-            trace = _child("trace", [
-                "--machines", str(r["machines"]),
-                "--tasks", str(r["tasks"]),
-                "--rounds", str(max(args.rounds * 4, 12)),
-            ], RUNG_TIMEOUT_S)
-            break
+    emit()  # a valid (empty-ladder) line exists before any child runs
+    parity = _child("parity", [], PARITY_TIMEOUT_S)
     emit()
+
+    # North-star rung FIRST: it is the scored number and must get the
+    # freshest budget.  Then the trace replay (BASELINE config 5) — ahead
+    # of the scaling-table rungs, which round 4 lost to an outer timeout.
+    first = run_rung_child(*ladder[0])
+    if first.get("ok"):
+        t_machines, t_tasks = first["machines"], first["tasks"]
+    elif args.machines:
+        # Single-config smokes never pay an unrequested scale: a failed
+        # requested rung sizes the trace at the requested config anyway
+        # (its own timeout bounds it).
+        t_machines, t_tasks = ladder[0]
+    else:
+        t_machines, t_tasks = 1_000, 10_000  # modest, completable sizing
+    trace = _child("trace", [
+        "--machines", str(t_machines), "--tasks", str(t_tasks),
+        "--rounds", str(max(args.rounds * 4, 12)),
+    ], RUNG_TIMEOUT_S)
+    emit()
+    if not args.machines:
+        # Full-ladder mode only: single-config runs are quick focused
+        # smokes and must not pay an unrequested cluster-scale stage.
+        # 4k machines (round-4 review: the reference's behavior claims
+        # are cluster-scale claims; 1k hid the admissibility-masking and
+        # multi-round costs).
+        features = _child("features", [
+            "--machines", "4000", "--rounds", "3",
+        ], FEATURES_TIMEOUT_S)
+        emit()
+    for machines, tasks in ladder[1:]:
+        run_rung_child(machines, tasks)
     return 0
 
 

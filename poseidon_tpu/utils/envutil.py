@@ -188,6 +188,22 @@ def serialize_device_access(timeout=_ENV_TIMEOUT) -> bool:
     return True
 
 
+def release_device_lock() -> None:
+    """Drop the host-wide accelerator lock early.
+
+    For processes that took the lock to PROBE and then latched a CPU
+    verdict: they will never touch the chip again, and holding the
+    exclusive flock through an hours-long CPU run would block every
+    other accelerator user (the OS-on-exit release is too late)."""
+    global _device_lock_fd
+    if _device_lock_fd is not None:
+        try:
+            os.close(_device_lock_fd)
+        except OSError:
+            pass
+        _device_lock_fd = None
+
+
 def install_graceful_term() -> None:
     """Make SIGTERM exit at the next Python bytecode boundary.
 

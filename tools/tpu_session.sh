@@ -4,19 +4,51 @@
 # last, everything through ONE process at a time (the flock in
 # envutil.serialize_device_access); never externally kill any step —
 # each step bounds itself internally.
+#
+# TPU_SESSION_DRYRUN=1 reruns the exact same step sequence on a clean
+# CPU environment (accelerator plugin stripped, smoke-sized configs) so
+# the script's own plumbing — paths, flags, tee targets, JSON parsing —
+# is proven BEFORE it meets scarce live-tunnel time.  Only the
+# TPU-specific lines (Mosaic lowering, real dispatch costs) remain
+# unproven after a green dry run.
 set -uo pipefail
 cd "$(dirname "${BASH_SOURCE[0]}")/.."
 mkdir -p out
 
+SUFFIX=""
+if [ "${TPU_SESSION_DRYRUN:-}" = "1" ]; then
+  echo "=== DRY RUN: clean-CPU environment, smoke-sized configs ==="
+  SUFFIX=".dryrun"
+  # The env var alone is not enough when the accelerator site hook is
+  # present (it re-pins the platform and hangs on a dead tunnel):
+  # strip the plugin the same way envutil.clean_cpu_env does.
+  export JAX_PLATFORMS=cpu
+  unset PALLAS_AXON_POOL_IPS 2>/dev/null || true
+  PYTHONPATH="$(python - <<'EOF'
+import os
+print(os.pathsep.join(
+    [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+     if p and "axon" not in p] + [os.getcwd()]))
+EOF
+)"
+  export PYTHONPATH
+  export POSEIDON_BENCH_FUSED_SMOKE=1
+  PROFILE_ARGS="--machines 200 --ecs 32"
+  BENCH_ARGS="--machines 200 --tasks 2000 --rounds 2"
+else
+  PROFILE_ARGS="--machines 1000 --ecs 100"
+  BENCH_ARGS="--verbose"
+fi
+
 echo "=== 1. latency decomposition (tunnel dispatch / transfer / solve)"
-python tools/profile_solver.py --machines 1000 --ecs 100 2>&1 | tee out/tpu_profile_1k.txt
+python tools/profile_solver.py $PROFILE_ARGS 2>&1 | tee "out/tpu_profile_1k.txt$SUFFIX"
 
 echo "=== 2. fused-kernel Mosaic validation + A/B vs lax path"
-python tools/bench_fused.py 2>&1 | tee out/tpu_fused_ab.txt
+python tools/bench_fused.py 2>&1 | tee "out/tpu_fused_ab.txt$SUFFIX"
 
 echo "=== 3. full bench ladder (tagged backend; partial lines salvage)"
 POSEIDON_BENCH_RUNG_TIMEOUT="${POSEIDON_BENCH_RUNG_TIMEOUT:-3000}" \
-python bench.py --verbose 2> >(tee out/tpu_bench_stderr.txt >&2) | tee out/tpu_bench.jsonl
+python bench.py $BENCH_ARGS 2> >(tee "out/tpu_bench_stderr.txt$SUFFIX" >&2) | tee "out/tpu_bench.jsonl$SUFFIX"
 
 echo "=== done; last bench line:"
-tail -1 out/tpu_bench.jsonl
+tail -1 "out/tpu_bench.jsonl$SUFFIX"
