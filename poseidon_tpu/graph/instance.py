@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import enum
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -382,7 +383,12 @@ class RoundPlanner:
         model's static bound (max_cost_hint).  Returns the number of
         shapes compiled.
         """
-        from poseidon_tpu.ops.transport import bucket_size, padded_shape
+        from poseidon_tpu.ops.transport import (
+            COARSE_GROUPS,
+            COARSE_MIN_MACHINES,
+            bucket_size,
+            padded_shape,
+        )
 
         if self.flow_solver == "ssp":
             return 0
@@ -407,14 +413,20 @@ class RoundPlanner:
                 # depends on BOTH padded axes): compile those exact keys
                 # too so the first churn rounds don't pay the warm-in.
                 widths = [(m_bucket, None)]
+                scale_full, _ = derive_scale(
+                    probe_costs, probe_unsched, hint,
+                    *padded_shape(e_bucket, m_bucket),
+                )
                 w = 128
                 while w * 4 < m_bucket * 3:
-                    scale_full, _ = derive_scale(
-                        probe_costs, probe_unsched, hint,
-                        *padded_shape(e_bucket, m_bucket),
-                    )
                     widths.append((w, scale_full))
                     w *= 4
+                if m_bucket >= max(COARSE_MIN_MACHINES, 4 * COARSE_GROUPS):
+                    # The coarse wave warm start solves [E, COARSE_GROUPS]
+                    # at the full bucket's scale — same compile-key rule
+                    # as the selective widths (whose 128*4^k ladder never
+                    # lands on 256).
+                    widths.append((COARSE_GROUPS, scale_full))
                 for width, scale in widths:
                     costs = rng.integers(
                         0, hint + 1, size=(e_bucket, width)
@@ -433,15 +445,22 @@ class RoundPlanner:
                     # skip the very shape dense rounds need); the
                     # sharded dispatch never reduces, so it keeps the
                     # configured path.
-                    if scale is not None:
-                        solve_transport(
-                            costs, supply, cap, unsched, arc_capacity=arc,
-                            max_cost_hint=hint, scale=scale,
-                        )
-                    elif self.solver_devices > 1:
+                    if self.solver_devices > 1 and (
+                        scale is None or width == COARSE_GROUPS
+                    ):
+                        # Shapes the sharded dispatch will actually see
+                        # (full bucket; coarse width) compile through it.
+                        # Selective widths never occur under sharding —
+                        # its dispatch never reduces.
                         self._dispatch_solve(
                             costs, supply, cap, unsched, arc_capacity=arc,
                             max_cost_hint=hint,
+                            **({} if scale is None else {"scale": scale}),
+                        )
+                    elif scale is not None:
+                        solve_transport(
+                            costs, supply, cap, unsched, arc_capacity=arc,
+                            max_cost_hint=hint, scale=scale,
                         )
                     else:
                         solve_transport(
@@ -861,6 +880,26 @@ class RoundPlanner:
                 # potentials mass-saturates arcs the ladder then
                 # unwinds).  Cold is uniformly fast and certified.
                 prices = flows0 = unsched0 = None
+
+        if (prices is None and self.flow_solver != "ssp"
+                and os.environ.get("POSEIDON_COARSE", "1") != "0"):
+            # Fresh-wave coarse start: solve the machine-AGGREGATED
+            # instance exactly (cheap: [E, 256] through the same
+            # dispatch, sharded or not), lift its duals and primal, and
+            # start the ladder at the lift's certified epsilon.  The
+            # cold ~500-iteration redistribution collapses to <100
+            # (transport.coarse_warm_start: 588 -> 78 at 1k, 604 -> 75
+            # at 4k, identical objectives).  Declines (None) on small or
+            # thin instances and whenever the certificate gate fails.
+            from poseidon_tpu.ops.transport import coarse_warm_start
+
+            cs = coarse_warm_start(
+                cm.costs, ecs_b.supply, col_cap, cm.unsched_cost,
+                cm.arc_capacity, self._dispatch_solve,
+                max_cost_hint=self.cost_model.max_cost(),
+            )
+            if cs is not None:
+                prices, flows0, unsched0, eps_start = cs
 
         def run(costs, eps, p=None, f=None, u=None):
             # Policy iteration budgets (the kernel default is a pure

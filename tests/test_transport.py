@@ -543,3 +543,86 @@ def test_shape_churn_does_not_recompile():
     solve(16, 50, 137)   # cost bound drifts under the hint
     solve(13, 48, 20)
     assert _solve_device._cache_size() == before
+
+
+def test_coarse_warm_start_exact_and_gated():
+    """The coarse (machine-aggregated) wave warm start must (a) produce a
+    feasible lift whose warmed solve reaches the exact oracle objective
+    with a zero-gap certificate, and (b) decline instances below its
+    size gates (small M, thin supply) so churn/selective rounds are
+    untouched."""
+    from poseidon_tpu.ops.transport import (
+        COARSE_GROUPS,
+        coarse_warm_start,
+        solve_transport,
+    )
+
+    rng = np.random.default_rng(11)
+    E, M = 24, max(2048, 4 * COARSE_GROUPS)
+    # Load-shaped columns (a per-machine offset) + request-shaped rows:
+    # the structure the grouping keys on.
+    load = rng.integers(0, 400, size=M).astype(np.int32)
+    base = rng.integers(50, 800, size=E).astype(np.int32)
+    costs = (base[:, None] + load[None, :]).astype(np.int32)
+    supply = rng.integers(40, 90, size=E).astype(np.int32)
+    cap = rng.integers(1, 3, size=M).astype(np.int32)
+    unsched = np.full(E, 5000, dtype=np.int32)
+
+    calls = []
+
+    def solve(*args, **kw):
+        calls.append(args[0].shape)
+        return solve_transport(*args, **kw)
+
+    cs = coarse_warm_start(costs, supply, cap, unsched, None, solve)
+    assert cs is not None and calls == [(E, COARSE_GROUPS)]
+    prices, flows, left, eps = cs
+    # Feasible lift: column capacity and supply conservation hold.
+    assert (flows.sum(axis=0) <= cap).all()
+    assert (flows.sum(axis=1) + left == supply).all()
+    assert eps >= 1
+
+    warmed = solve_transport(
+        costs, supply, cap, unsched, prices, init_flows=flows,
+        init_unsched=left, eps_start=eps, greedy_init=False,
+    )
+    cold = solve_transport(costs, supply, cap, unsched)
+    assert warmed.gap_bound == 0.0
+    assert warmed.objective == cold.objective
+    expected = oracle.transport_objective(costs, supply, cap, unsched)
+    assert warmed.objective == expected
+
+    # Gates: small machine axis / thin supply decline.
+    assert coarse_warm_start(
+        costs[:, :512], supply, cap[:512], unsched, None, solve
+    ) is None
+    assert coarse_warm_start(
+        costs, np.ones(E, dtype=np.int32), cap, unsched, None, solve
+    ) is None
+
+
+def test_selective_honors_pinned_scale():
+    """A caller-pinned scale (the coarse warm start pins the full
+    instance's scale onto its aggregated solve, which may route through
+    the selective wrapper) must be honored on BOTH selective branches —
+    regression: the reduced branch forwarded **kw containing 'scale'
+    into a call that also passed scale positionally (TypeError)."""
+    from poseidon_tpu.ops.transport import (
+        derive_scale,
+        padded_shape,
+        solve_transport_selective,
+    )
+
+    rng = np.random.default_rng(5)
+    E, M = 8, 600
+    costs = rng.integers(10, 2000, size=(E, M)).astype(np.int32)
+    supply = np.full(E, 4, dtype=np.int32)   # sparse: reduction fires
+    cap = np.full(M, 8, dtype=np.int32)
+    unsched = np.full(E, 9000, dtype=np.int32)
+    scale, _ = derive_scale(costs, unsched, None, *padded_shape(E, M))
+    sol = solve_transport_selective(
+        costs, supply, cap, unsched, scale=scale * 2,  # deliberately odd
+    )
+    expected = oracle.transport_objective(costs, supply, cap, unsched)
+    assert sol.objective == expected
+    assert sol.gap_bound == 0.0
