@@ -908,7 +908,7 @@ def _coarse_disaggregate(flows_g, costs, capacity, arc_capacity, gid,
 
 
 def coarse_warm_start(costs, supply, capacity, unsched_cost, arc_capacity,
-                      solve, *, max_cost_hint=None, groups=COARSE_GROUPS):
+                      solve, *, max_cost_hint=None, groups=None):
     """Fresh-wave warm start from an exactly solved aggregated instance.
 
     The ~500-iteration fresh-wave solve is dominated by redistribution
@@ -927,6 +927,10 @@ def coarse_warm_start(costs, supply, capacity, unsched_cost, arc_capacity,
     (instance too small / coarse solve unconverged / certified eps above
     the cold-start gate — callers then run the plain cold ladder).
     """
+    if groups is None:
+        # Resolved at CALL time so tests can patch the module constants
+        # (a definition-time default froze the production value).
+        groups = COARSE_GROUPS
     E, M = costs.shape
     if M < max(COARSE_MIN_MACHINES, 4 * groups):
         return None
