@@ -626,3 +626,38 @@ def test_selective_honors_pinned_scale():
     expected = oracle.transport_objective(costs, supply, cap, unsched)
     assert sol.objective == expected
     assert sol.gap_bound == 0.0
+
+
+def test_selective_precheck_skips_reduction_when_duals_certify(monkeypatch):
+    """Cold steady-state rounds whose FULL-instance greedy+auction-dual
+    start is already near-optimal must go straight to the full-width
+    solve — the column reduction makes the union columns everyone's
+    cheapest and can be cost-contended where the full instance is not
+    (measured at 10k/100k churn: 554 reduced iterations vs ZERO
+    full-width, identical objectives)."""
+    import poseidon_tpu.ops.transport as T
+
+    rng = np.random.default_rng(17)
+    E, M = 12, 800
+    # Uncontested: ample capacity, per-row distinct cheap tiers.
+    costs = rng.integers(500, 3000, size=(E, M)).astype(np.int32)
+    for e in range(E):
+        costs[e, e * 40:(e + 1) * 40] = 10 + e
+    supply = np.full(E, 6, dtype=np.int32)
+    cap = np.full(M, 4, dtype=np.int32)
+    unsched = np.full(E, 9000, dtype=np.int32)
+
+    widths = []
+    inner = T.solve_transport
+
+    def spy(costs_, *a, **k):
+        widths.append(np.asarray(costs_).shape[1])
+        return inner(costs_, *a, **k)
+
+    monkeypatch.setattr(T, "solve_transport", spy)
+    sol = T.solve_transport_selective(costs, supply, cap, unsched)
+    assert widths == [M], widths  # one full-width solve, no reduction
+    assert sol.iterations == 0
+    assert sol.gap_bound == 0.0
+    expected = oracle.transport_objective(costs, supply, cap, unsched)
+    assert sol.objective == expected
