@@ -76,8 +76,9 @@ NORTH_STAR = (10_000, 100_000)
 LADDER = [NORTH_STAR, (1_000, 10_000), (2_000, 20_000), (4_000, 40_000)]
 RUNG_TIMEOUT_S = int(os.environ.get("POSEIDON_BENCH_RUNG_TIMEOUT", "1800"))
 PARITY_TIMEOUT_S = 600
-# BASELINE configs 2-4 (selectors/affinity/gang) run at cluster scale;
-# 4k machines needs more than the parity budget.
+# BASELINE configs 2-4 (selectors/affinity/gang) run at the north-star
+# scale (10k machines, ~45 s warm + compile headroom); cluster scale
+# needs more than the parity budget.
 FEATURES_TIMEOUT_S = int(
     os.environ.get("POSEIDON_BENCH_FEATURES_TIMEOUT", "1200")
 )
@@ -871,11 +872,13 @@ def main(argv=None) -> int:
     if not args.machines:
         # Full-ladder mode only: single-config runs are quick focused
         # smokes and must not pay an unrequested cluster-scale stage.
-        # 4k machines (round-4 review: the reference's behavior claims
-        # are cluster-scale claims; 1k hid the admissibility-masking and
-        # multi-round costs).
+        # NORTH-STAR scale (round-4 review asked 4k, 10k if budget
+        # allows; the round-5 wave/churn work made 10k cost ~45 s warm):
+        # the reference's behavior claims are cluster-scale claims, and
+        # the semantic predicates (zero violations, whole gangs) now
+        # hold at the scale the project's headline claims.
         features = _child("features", [
-            "--machines", "4000", "--rounds", "3",
+            "--machines", "10000", "--rounds", "3",
         ], FEATURES_TIMEOUT_S)
         emit()
     for machines, tasks in ladder[1:]:
