@@ -384,9 +384,9 @@ class RoundPlanner:
         shapes compiled.
         """
         from poseidon_tpu.ops.transport import (
-            COARSE_GROUPS,
             COARSE_MIN_MACHINES,
             bucket_size,
+            coarse_group_count,
             padded_shape,
         )
 
@@ -421,12 +421,14 @@ class RoundPlanner:
                 while w * 4 < m_bucket * 3:
                     widths.append((w, scale_full))
                     w *= 4
-                if m_bucket >= max(COARSE_MIN_MACHINES, 4 * COARSE_GROUPS):
-                    # The coarse wave warm start solves [E, COARSE_GROUPS]
-                    # at the full bucket's scale — same compile-key rule
-                    # as the selective widths (whose 128*4^k ladder never
-                    # lands on 256).
-                    widths.append((COARSE_GROUPS, scale_full))
+                if (m_bucket >= COARSE_MIN_MACHINES
+                        and coarse_group_count(m_bucket) == 256):
+                    # The coarse wave warm start solves [E, 256] at the
+                    # full bucket's scale — same compile-key rule as the
+                    # selective widths (whose 128*4^k ladder never lands
+                    # on 256; the mid-size coarse width IS 128, which
+                    # that ladder already compiles).
+                    widths.append((256, scale_full))
                 for width, scale in widths:
                     costs = rng.integers(
                         0, hint + 1, size=(e_bucket, width)
@@ -446,12 +448,14 @@ class RoundPlanner:
                     # sharded dispatch never reduces, so it keeps the
                     # configured path.
                     if self.solver_devices > 1 and (
-                        scale is None or width == COARSE_GROUPS
+                        scale is None
+                        or width == coarse_group_count(m_bucket)
                     ):
                         # Shapes the sharded dispatch will actually see
-                        # (full bucket; coarse width) compile through it.
-                        # Selective widths never occur under sharding —
-                        # its dispatch never reduces.
+                        # (full bucket; the bucket's coarse width — 256,
+                        # or 128 for mid-size buckets) compile through
+                        # it.  Other selective widths never occur under
+                        # sharding — its dispatch never reduces.
                         self._dispatch_solve(
                             costs, supply, cap, unsched, arc_capacity=arc,
                             max_cost_hint=hint,
