@@ -657,6 +657,71 @@ def run_parity() -> dict:
     }
 
 
+def build_artifact(rungs, target, parity, trace, features) -> dict:
+    """The scored JSON line the driver records.
+
+    Scores ONLY the target config (the north star, or the requested
+    config in single-config mode): a bench that loses rungs to a
+    timeout must post a WORSE artifact, never a better-looking one
+    (round-4 review: "largest completed rung" scoring rewarded
+    timeouts).  An unconverged target rung posts no vs_baseline:
+    budget-exhausted solves return fast but commit uncertified
+    placements, and claiming a win on them would be dishonest.
+    Module-level and pure so tests can pin the scoring contract.
+    """
+    best = None
+    for r in rungs:
+        if (r.get("ok")
+                and (r.get("machines"), r.get("tasks")) == target):
+            best = r
+    out = {
+        "metric": "schedule_round_s",
+        "unit": "s",
+        "target_machines": target[0],
+        "target_tasks": target[1],
+        # Parity failure and parity-harness failure are different
+        # triage paths: surface the whole child result, not the bit.
+        "parity_ok": parity.get("parity_ok", False),
+        "parity": parity,
+        "trace": trace,
+        # BASELINE configs 2-4: selectors / pod affinity / gang, with
+        # semantic predicates (violations must be zero) next to the
+        # latency numbers.
+        "features": features,
+        "ladder": rungs,
+    }
+    if best is None:
+        out.update({"value": None, "vs_baseline": 0.0,
+                    "error": f"target rung {target[0]}/{target[1]} "
+                             "not completed"})
+    else:
+        # Headline: a full pending wave at the north-star config
+        # (BASELINE.md: "10k nodes / 100k pending pods round < 1 s").
+        # Steady-state churn p50 is reported alongside (the latency a
+        # production cluster pays every round) but does not set the
+        # score.
+        value = best["wave_p50_s"]
+        honest = bool(best.get("converged"))
+        out.update({
+            "value": value,
+            "vs_baseline": (
+                round(1.0 / value, 3) if honest and value > 0 else 0.0
+            ),
+            "converged": best.get("converged"),
+            "machines": best["machines"],
+            "tasks": best["tasks"],
+            "backend": best.get("backend"),
+            "cold_s": best["cold_s"],
+            "wave_p50_s": best["wave_p50_s"],
+            "churn_p50_s": best["churn_p50_s"],
+            # Recovery-to-first-placement after a checkpoint restore
+            # at the scored scale (the warm frames ride the
+            # checkpoint; the reference has no counterpart).
+            "restart_s": best.get("restart_round_s"),
+        })
+    return out
+
+
 def _child(mode: str, argv: list, timeout: int) -> dict:
     """Run one rung/parity in a subprocess; never raises.
 
@@ -775,62 +840,9 @@ def main(argv=None) -> int:
     features = {"ok": False, "error": "not run"}
 
     def emit():
-        # Score ONLY the target config (the north star, or the requested
-        # config in single-config mode): a bench that loses rungs to a
-        # timeout must post a WORSE artifact, never a better-looking one.
-        best = None
-        for r in rungs:
-            if (r.get("ok")
-                    and (r.get("machines"), r.get("tasks")) == target):
-                best = r
-        out = {
-            "metric": "schedule_round_s",
-            "unit": "s",
-            "target_machines": target[0],
-            "target_tasks": target[1],
-            # Parity failure and parity-harness failure are different
-            # triage paths: surface the whole child result, not the bit.
-            "parity_ok": parity.get("parity_ok", False),
-            "parity": parity,
-            "trace": trace,
-            # BASELINE configs 2-4: selectors / pod affinity / gang, with
-            # semantic predicates (violations must be zero) next to the
-            # latency numbers.
-            "features": features,
-            "ladder": rungs,
-        }
-        if best is None:
-            out.update({"value": None, "vs_baseline": 0.0,
-                        "error": f"target rung {target[0]}/{target[1]} "
-                                 "not completed"})
-        else:
-            # Headline: a full pending wave at the north-star config
-            # (BASELINE.md: "10k nodes / 100k pending pods round < 1 s").
-            # Steady-state churn p50 is reported alongside (the latency a
-            # production cluster pays every round) but does not set the
-            # score.  An unconverged rung posts no vs_baseline: budget-
-            # exhausted solves return fast but commit uncertified
-            # placements, and claiming a win on them would be dishonest.
-            value = best["wave_p50_s"]
-            honest = bool(best.get("converged"))
-            out.update({
-                "value": value,
-                "vs_baseline": (
-                    round(1.0 / value, 3) if honest and value > 0 else 0.0
-                ),
-                "converged": best.get("converged"),
-                "machines": best["machines"],
-                "tasks": best["tasks"],
-                "backend": best.get("backend"),
-                "cold_s": best["cold_s"],
-                "wave_p50_s": best["wave_p50_s"],
-                "churn_p50_s": best["churn_p50_s"],
-                # Recovery-to-first-placement after a checkpoint restore
-                # at the scored scale (the warm frames ride the
-                # checkpoint; the reference has no counterpart).
-                "restart_s": best.get("restart_round_s"),
-            })
-        print(json.dumps(out), flush=True)
+        print(json.dumps(
+            build_artifact(rungs, target, parity, trace, features)
+        ), flush=True)
 
     def run_rung_child(machines, tasks):
         res = _child("rung", [

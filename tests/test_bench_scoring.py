@@ -1,0 +1,73 @@
+"""The bench artifact's scoring contract (round-4 review, Weak #1).
+
+The scored ``value``/``vs_baseline`` must be pinned to the TARGET config:
+a bench that loses rungs to a timeout posts a worse artifact, never a
+better-looking one, and uncertified (unconverged) rungs never score.
+``build_artifact`` is pure, so these run without any child process.
+"""
+
+from bench import build_artifact
+
+TARGET = (10_000, 100_000)
+OK_PARITY = {"parity_ok": True, "ok": True}
+NONE_RUN = {"ok": False, "error": "not run"}
+
+
+def rung(machines, tasks, wave, *, ok=True, converged=True):
+    return {
+        "machines": machines, "tasks": tasks, "ok": ok,
+        "converged": converged, "wave_p50_s": wave, "cold_s": 10.0,
+        "churn_p50_s": 0.1, "restart_round_s": 0.5, "backend": "cpu",
+    }
+
+
+def test_scores_only_the_target_config():
+    # A completed SMALLER rung must not set the score (the round-4
+    # flattery: 4k completed, 10k absent, score posted anyway).
+    out = build_artifact(
+        [rung(4_000, 40_000, 1.9)], TARGET, OK_PARITY, NONE_RUN, NONE_RUN,
+    )
+    assert out["value"] is None
+    assert out["vs_baseline"] == 0.0
+    assert "not completed" in out["error"]
+
+
+def test_target_rung_scores_with_restart():
+    out = build_artifact(
+        [rung(10_000, 100_000, 5.0), rung(1_000, 10_000, 0.3)],
+        TARGET, OK_PARITY, NONE_RUN, NONE_RUN,
+    )
+    assert out["value"] == 5.0
+    assert out["vs_baseline"] == 0.2
+    assert out["restart_s"] == 0.5
+    assert out["machines"] == 10_000
+
+
+def test_unconverged_target_posts_no_score():
+    out = build_artifact(
+        [rung(10_000, 100_000, 0.5, converged=False)],
+        TARGET, OK_PARITY, NONE_RUN, NONE_RUN,
+    )
+    # A fast-but-uncertified wave would otherwise look like a 2x win.
+    assert out["value"] == 0.5
+    assert out["vs_baseline"] == 0.0
+    assert out["converged"] is False
+
+
+def test_failed_target_rung_does_not_score():
+    out = build_artifact(
+        [{"machines": 10_000, "tasks": 100_000, "ok": False,
+          "error": "timeout", "wave_p50_s": 3.0}],
+        TARGET, OK_PARITY, NONE_RUN, NONE_RUN,
+    )
+    assert out["value"] is None and out["vs_baseline"] == 0.0
+
+
+def test_single_config_mode_scores_requested_config():
+    target = (200, 2_000)
+    out = build_artifact(
+        [rung(200, 2_000, 0.2)], target, OK_PARITY, NONE_RUN, NONE_RUN,
+    )
+    assert out["value"] == 0.2
+    assert out["vs_baseline"] == 5.0
+    assert out["target_machines"] == 200
