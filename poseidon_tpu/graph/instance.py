@@ -385,6 +385,7 @@ class RoundPlanner:
         """
         from poseidon_tpu.ops.transport import (
             COARSE_MIN_MACHINES,
+            accel_policy,
             bucket_size,
             coarse_group_count,
             padded_shape,
@@ -429,6 +430,31 @@ class RoundPlanner:
                     # on 256; the mid-size coarse width IS 128, which
                     # that ladder already compiles).
                     widths.append((256, scale_full))
+                if (m_bucket >= COARSE_MIN_MACHINES
+                        and self.solver_devices == 1
+                        and accel_policy("POSEIDON_COARSE_FUSED")):
+                    # The single-dispatch fused pipeline is its own jit
+                    # program with its own static keys (groups, block,
+                    # scale): warm it here or the first qualifying wave
+                    # pays the full compile through the tunnel.
+                    from poseidon_tpu.ops.transport_coarse import (
+                        solve_transport_coarse_fused,
+                    )
+
+                    probe_c = rng.integers(
+                        0, hint + 1, size=(e_bucket, m_bucket)
+                    ).astype(np.int32)
+                    solve_transport_coarse_fused(
+                        probe_c, np.ones(e_bucket, dtype=np.int32),
+                        np.ones(m_bucket, dtype=np.int32),
+                        np.full(e_bucket, hint, dtype=np.int32),
+                        arc_capacity=np.ones(
+                            (e_bucket, m_bucket), dtype=np.int32
+                        ),
+                        max_cost_hint=hint, max_iter_total=8192,
+                        force=True,
+                    )
+                    compiled += 1
                 for width, scale in widths:
                     costs = rng.integers(
                         0, hint + 1, size=(e_bucket, width)
@@ -885,6 +911,7 @@ class RoundPlanner:
                 # unwinds).  Cold is uniformly fast and certified.
                 prices = flows0 = unsched0 = None
 
+        sol = None
         if (prices is None and self.flow_solver != "ssp"
                 and os.environ.get("POSEIDON_COARSE", "1") != "0"):
             # Fresh-wave coarse start: solve the machine-AGGREGATED
@@ -895,15 +922,50 @@ class RoundPlanner:
             # (transport.coarse_warm_start: 588 -> 78 at 1k, 604 -> 75
             # at 4k, identical objectives).  Declines (None) on small or
             # thin instances and whenever the certificate gate fails.
-            from poseidon_tpu.ops.transport import coarse_warm_start
-
-            cs = coarse_warm_start(
-                cm.costs, ecs_b.supply, col_cap, cm.unsched_cost,
-                cm.arc_capacity, self._dispatch_solve,
-                max_cost_hint=self.cost_model.max_cost(),
+            #
+            # On accelerator backends the WHOLE pipeline (aggregate ->
+            # coarse ladder -> lift -> disaggregate -> certify -> full
+            # ladder) runs as ONE jitted program instead — per-dispatch
+            # tunnel cost is the H2 wave budget, and the fused path is
+            # plain XLA (no Mosaic risk).  A declined or unconverged
+            # fused solve falls through to the two-dispatch host path.
+            from poseidon_tpu.ops.transport import (
+                accel_policy,
+                coarse_precheck,
+                coarse_warm_start,
             )
-            if cs is not None:
-                prices, flows0, unsched0, eps_start = cs
+
+            hint = self.cost_model.max_cost()
+            # Size gates + greedy certificate ONCE; both coarse paths
+            # consume the bundle (a fused decline must not redo the
+            # O(E*M) host work in the fallback).
+            pre = coarse_precheck(
+                cm.costs, ecs_b.supply, col_cap, cm.arc_capacity,
+                cm.unsched_cost, hint,
+            )
+            if pre is not None:
+                if (self.solver_devices == 1
+                        and not pre["certified"]
+                        and accel_policy("POSEIDON_COARSE_FUSED")):
+                    from poseidon_tpu.ops.transport_coarse import (
+                        solve_transport_coarse_fused,
+                    )
+
+                    sol = solve_transport_coarse_fused(
+                        cm.costs, ecs_b.supply, col_cap, cm.unsched_cost,
+                        arc_capacity=cm.arc_capacity, max_cost_hint=hint,
+                        max_iter_total=8192,
+                        global_update_every=self.global_update_every,
+                        pre=pre,
+                    )
+                if sol is None:
+                    cs = coarse_warm_start(
+                        cm.costs, ecs_b.supply, col_cap, cm.unsched_cost,
+                        cm.arc_capacity, self._dispatch_solve,
+                        max_cost_hint=hint, pre=pre,
+                    )
+                    if cs is not None:
+                        prices, flows0, unsched0, eps_start = cs
 
         def run(costs, eps, p=None, f=None, u=None):
             # Policy iteration budgets (the kernel default is a pure
@@ -929,11 +991,12 @@ class RoundPlanner:
                 max_cost_hint=self.cost_model.max_cost(),
             )
 
-        sol = run(cm.costs, eps_start, prices, flows0, unsched0)
-        if prices is not None and sol.gap_bound == float("inf"):
-            # Any warm start can mislead (drift heuristic missed deep
-            # churn, or a poisoned carried frame): retry cold full ladder.
-            sol = run(cm.costs, None)
+        if sol is None:
+            sol = run(cm.costs, eps_start, prices, flows0, unsched0)
+            if prices is not None and sol.gap_bound == float("inf"):
+                # Any warm start can mislead (drift heuristic missed
+                # deep churn, or a poisoned carried frame): retry cold.
+                sol = run(cm.costs, None)
 
         effective_costs = cm.costs
         if (

@@ -840,27 +840,68 @@ def coarse_group_count(m: int, groups=None) -> int:
     return min(cap, 128 if m < 2048 else 256)
 
 
-def coarse_group_columns(costs, groups: int) -> np.ndarray:
-    """Group machine columns into supernodes of similar cost columns.
+def coarse_sort_order(costs) -> np.ndarray:
+    """The grouping key shared by BOTH coarse paths (host two-dispatch
+    and fused single-dispatch): sort columns by admissible column mean,
+    dead columns (no admissible rows) last.
 
     The cpu_mem cost is ~ per-machine load plus request-shaped terms, so
-    the admissible column mean captures the machine axis; sorting by it
-    and chunking into equal-count groups lands same-load machines
-    together.  Columns with no admissible rows sort to the end (their
-    groups aggregate to dead columns).
+    the admissible column mean captures the machine axis; chunking the
+    sorted order into equal-count groups lands same-load machines
+    together.  (Capacity-aware keys measured strictly worse —
+    docs/PERF.md round-5 negatives.)
     """
-    E, M = costs.shape
     adm = costs < INF_COST
     colmean = np.where(adm, costs, 0).sum(axis=0) / np.maximum(
         adm.sum(axis=0), 1
     )
     dead = ~adm.any(axis=0)
-    order = np.lexsort((colmean, dead))
+    return np.lexsort((colmean, dead))
+
+
+def coarse_group_columns(costs, groups: int) -> np.ndarray:
+    """Group machine columns into supernodes of similar cost columns
+    (equal-count chunks of `coarse_sort_order`)."""
+    M = costs.shape[1]
+    order = coarse_sort_order(costs)
     gid = np.empty(M, dtype=np.int64)
     bounds = np.linspace(0, M, groups + 1).astype(int)
     for g in range(groups):
         gid[order[bounds[g]:bounds[g + 1]]] = g
     return gid
+
+
+def coarse_precheck(costs, supply, capacity, arc_capacity, unsched_cost,
+                    max_cost_hint, groups=None):
+    """Shared size gates + greedy certificate for the coarse paths.
+
+    Returns ``None`` when the instance is too small/thin for any coarse
+    start, else a dict with the group count, padded shape, scale, and
+    the greedy+dual start (``certified`` True when that start is
+    already near-optimal — both coarse paths then decline in favor of
+    one plain dispatch seeded with it).  Computed ONCE per band by the
+    planner so a fused decline does not redo the O(E*M) host work.
+    """
+    E, M = costs.shape
+    if E == 0 or M < COARSE_MIN_MACHINES:
+        return None
+    K = coarse_group_count(M, groups)
+    if M < 4 * K or int(supply.sum()) < 4 * K:
+        return None
+    e_pad, m_pad = padded_shape(E, M)
+    scale, max_raw_q = derive_scale(
+        costs, unsched_cost, max_cost_hint, e_pad, m_pad
+    )
+    gf, gleft, gprices, geps, certified = greedy_dual_precheck(
+        costs, supply, capacity, arc_capacity, unsched_cost,
+        max_cost_hint, e_pad, m_pad, scale,
+    )
+    return {
+        "groups": K, "e_pad": e_pad, "m_pad": m_pad,
+        "scale": scale, "max_raw_q": max_raw_q,
+        "gf": gf, "gleft": gleft, "gprices": gprices, "geps": geps,
+        "certified": certified,
+    }
 
 
 def _coarse_aggregate(costs, capacity, arc_capacity, gid, groups):
@@ -942,7 +983,8 @@ def greedy_dual_precheck(costs, supply, capacity, arc_capacity,
 
 
 def coarse_warm_start(costs, supply, capacity, unsched_cost, arc_capacity,
-                      solve, *, max_cost_hint=None, groups=None):
+                      solve, *, max_cost_hint=None, groups=None,
+                      pre=None):
     """Fresh-wave warm start from an exactly solved aggregated instance.
 
     The ~500-iteration fresh-wave solve is dominated by redistribution
@@ -962,29 +1004,22 @@ def coarse_warm_start(costs, supply, capacity, unsched_cost, arc_capacity,
     the cold-start gate — callers then run the plain cold ladder).
     """
     E, M = costs.shape
-    if M < COARSE_MIN_MACHINES:
+    if pre is None:
+        pre = coarse_precheck(
+            costs, supply, capacity, arc_capacity, unsched_cost,
+            max_cost_hint, groups,
+        )
+    if pre is None:
         return None
-    # Resolved at CALL time so tests can patch the module constants
-    # (a definition-time default froze the production value).
-    groups = coarse_group_count(M, groups)
-    if M < 4 * groups:
-        return None
-    if int(supply.sum()) < 4 * groups:
-        return None  # thin rounds ride the selective path instead
-    e_pad, m_pad = padded_shape(E, M)
-    scale, max_raw_q = derive_scale(
-        costs, unsched_cost, max_cost_hint, e_pad, m_pad
+    groups, scale, max_raw_q = pre["groups"], pre["scale"], pre["max_raw_q"]
+    gf, gleft, gprices, geps = (
+        pre["gf"], pre["gleft"], pre["gprices"], pre["geps"]
     )
-    # Cheap pre-check: when the greedy+auction-dual start is already
-    # near-optimal (uncontested instance — certifies in ~0 iterations),
-    # the coarse solve is a pure extra dispatch.  Reuse that start
-    # directly instead (bit-identical to what the cold solve would
-    # derive internally).
-    gf, gleft, gprices, geps, certified = greedy_dual_precheck(
-        costs, supply, capacity, arc_capacity, unsched_cost,
-        max_cost_hint, e_pad, m_pad, scale,
-    )
-    if certified:
+    # When the greedy+auction-dual start is already near-optimal
+    # (uncontested instance — certifies in ~0 iterations), the coarse
+    # solve is a pure extra dispatch.  Reuse that start directly instead
+    # (bit-identical to what the cold solve would derive internally).
+    if pre["certified"]:
         return gprices, gf, gleft, geps
     gid = coarse_group_columns(costs, groups)
     Cg, capg, arcg = _coarse_aggregate(

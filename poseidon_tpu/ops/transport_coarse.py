@@ -1,0 +1,336 @@
+"""Single-dispatch coarse-to-fine wave solve (pure XLA, no Pallas).
+
+The planner's coarse warm start (`transport.coarse_warm_start`) costs a
+wave band TWO device dispatches: the aggregated [E, K] solve, a host
+round trip (dual lift, primal disaggregation, certificate), then the
+full-width solve.  On the tunneled accelerator every dispatch pays a
+fixed host<->device round trip (docs/PERF.md round-4 H2 hypothesis:
+~0.4 s per dispatch), so the round trip in the middle is potentially
+the single largest term of a TPU wave.
+
+This module runs the ENTIRE pipeline as ONE jitted program:
+
+  permute columns into contiguous equal-size blocks (host provides the
+  sort; everything after is on device) -> block-sum aggregation ->
+  coarse epsilon ladder (the same `_solve_device` phase machinery at
+  [E, K]) -> dual lift (block broadcast) -> primal disaggregation
+  (cheapest-member-first inside each block via a per-row scan with a
+  capacity cumsum — the host greedy in closed form) -> exact
+  epsilon certificate -> full-width epsilon ladder warm-started at it.
+
+Everything is plain ``jnp``/``lax`` — XLA compiles it on any backend,
+so unlike the Pallas kernels this path carries NO Mosaic-acceptance
+risk; the host still re-certifies the result (`_host_finalize`) and any
+non-convergence falls back to the ordinary two-dispatch path.
+
+Replaces (TPU-native): part of the solver stack external to the
+reference (deploy/firmament-deployment.yaml:29-31 shells out to the
+Firmament binary; no counterpart exists in-repo).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from poseidon_tpu.ops.transport import (
+    INF_COST,
+    LADDER_FACTOR,
+    NUM_PHASES,
+    PRICE_SPREAD_CAP,
+    UNBOUNDED_ARC_CAP,
+    _host_finalize,
+    _host_validate,
+    _solve_device,
+    _Telemetry,
+    coarse_precheck,
+    coarse_sort_order,
+    padded_shape,
+    TransportSolution,
+)
+
+
+def _certified_eps_device(F, Ffb, prices, *, C, U, Uem, capacity, supply,
+                          E, M):
+    """The host `_certified_eps`, in-program: every arc class it checks
+    (EC->machine forward/reverse, EC->sink fallback, machine->sink),
+    int32 — the same ranges the kernel itself uses (C is pre-scaled,
+    prices are spread-capped)."""
+    adm = C < INF_COST
+    pe = prices[:E]
+    pm = prices[E:E + M]
+    pt = prices[E + M]
+    rc = C + pe[:, None] - pm[None, :]
+    fwd = adm & (Uem - F > 0)
+    rev = adm & (F > 0)
+    worst = jnp.maximum(
+        jnp.max(jnp.where(fwd, -rc, 0)),
+        jnp.max(jnp.where(rev, rc, 0)),
+    )
+    rc_fb = U + pe - pt
+    fb_resid = supply - Ffb > 0
+    fb_loaded = Ffb > 0
+    worst = jnp.maximum(worst, jnp.max(jnp.where(fb_resid, -rc_fb, 0)))
+    worst = jnp.maximum(worst, jnp.max(jnp.where(fb_loaded, rc_fb, 0)))
+    # Machine->sink arcs (cost 0): Fmt equals the column sum here.
+    fmt = jnp.sum(F, axis=0)
+    rc_mt = pm - pt
+    mt_resid = capacity - fmt > 0
+    mt_loaded = fmt > 0
+    worst = jnp.maximum(worst, jnp.max(jnp.where(mt_resid, -rc_mt, 0)))
+    worst = jnp.maximum(worst, jnp.max(jnp.where(mt_loaded, rc_mt, 0)))
+    return jnp.maximum(worst, 1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("groups", "block", "max_iter", "scale")
+)
+def _coarse_fused_device(costs, supply, capacity, unsched_cost, arc_cap,
+                         perm, inv_perm, eps_sched_cold, eps_cap,
+                         max_iter_total, global_every, bf_max,
+                         *, groups, block, max_iter, scale):
+    """The one-dispatch pipeline.  Shapes: costs/arc [E, M] with
+    M == groups * block; perm/inv_perm [M] (host column sort into
+    contiguous similar-cost blocks); eps_sched_cold [NUM_PHASES] for the
+    aggregated solve; eps_cap scalar (max_c // 2, the ladder clamp)."""
+    E, M = costs.shape
+    K, B = groups, block
+
+    # ---- block views in sorted column space
+    costs_s = jnp.take(costs, perm, axis=1).reshape(E, K, B)
+    cap_s = jnp.take(capacity, perm).reshape(K, B)
+    arc_s = jnp.take(arc_cap, perm, axis=1).reshape(E, K, B)
+    adm_s = costs_s < INF_COST
+
+    # ---- aggregation: admissible-mean costs, summed capacities
+    n_adm = jnp.sum(adm_s, axis=-1)                          # [E, K]
+    csum = jnp.sum(jnp.where(adm_s, costs_s, 0), axis=-1)    # raw costs
+    # COST_CAP (2^14) x block keeps the int32 cost sum exact; round
+    # half-up.
+    Cg = jnp.where(
+        n_adm > 0,
+        (csum + n_adm // 2) // jnp.maximum(n_adm, 1),
+        INF_COST,
+    ).astype(jnp.int32)
+    # Per-member clip scaled by the block size so the int32 block SUM is
+    # exact at any B, while "effectively unbounded" group capacities
+    # stay far above any feasible supply (flow mass < 2^31, validated).
+    lim = (1 << 29) // B
+    capg = jnp.sum(jnp.minimum(cap_s, lim), axis=-1)
+    arcg = jnp.sum(
+        jnp.minimum(jnp.where(adm_s, arc_s, 0), lim), axis=-1
+    ).astype(jnp.int32)
+
+    # ---- coarse ladder at [E, K] (cold: zero prices/flows)
+    zK = jnp.zeros(E + K + 1, dtype=jnp.int32)
+    Fc, Ffb_c, prices_c, it_c, bf_c, clean_c, _pi = _solve_device(
+        Cg, supply, capg.astype(jnp.int32), unsched_cost, arcg,
+        zK, jnp.zeros((E, K), jnp.int32), jnp.zeros(E, jnp.int32),
+        eps_sched_cold, max_iter_total, global_every, bf_max,
+        max_iter=max_iter, scale=scale,
+    )
+
+    # ---- dual lift: group potential broadcast to members, back to the
+    # original column order; normalized (anchor max=0, spread-capped)
+    # exactly as solve_transport does for any warm start.
+    pe = prices_c[:E]
+    pm_blocks = jnp.repeat(prices_c[E:E + K], B)             # sorted space
+    pm = jnp.take(pm_blocks, inv_perm)                        # original
+    pt = prices_c[E + K]
+    lifted = jnp.concatenate([pe, pm, pt[None]])
+    lifted = jnp.maximum(
+        lifted - jnp.max(lifted), -PRICE_SPREAD_CAP
+    ).astype(jnp.int32)
+
+    # ---- primal disaggregation: rows in order (matching the host
+    # algorithm), each distributing its block flow cheapest-member-first
+    # under the live remaining column capacities — the sequential greedy
+    # as a cumsum, K blocks in parallel per row.
+    order = jnp.argsort(
+        jnp.where(adm_s, costs_s, INF_COST), axis=-1, stable=True
+    )                                                         # [E, K, B]
+    inv_order = jnp.argsort(order, axis=-1, stable=True)
+
+    def disagg_row(col_left, row):
+        want, arc_row, adm_row, ord_row, inv_row = row
+        caps = jnp.where(adm_row, jnp.minimum(col_left, arc_row), 0)
+        caps_o = jnp.take_along_axis(caps, ord_row, axis=-1)
+        before = jnp.cumsum(caps_o, axis=-1) - caps_o
+        take_o = jnp.clip(
+            jnp.minimum(caps_o, want[:, None] - before), 0, None
+        )
+        take = jnp.take_along_axis(take_o, inv_row, axis=-1)
+        return col_left - take, take
+
+    _, takes = lax.scan(
+        disagg_row, cap_s.astype(jnp.int32),
+        (Fc, arc_s, adm_s, order, inv_order),
+    )                                                         # [E, K, B]
+    F0 = jnp.take(takes.reshape(E, M), inv_perm, axis=1)
+    fb0 = (supply - jnp.sum(F0, axis=1)).astype(jnp.int32)
+
+    # ---- exact lift certificate -> full ladder start
+    Cs = jnp.where(
+        costs >= INF_COST, INF_COST, costs * scale
+    ).astype(jnp.int32)
+    Uem = jnp.minimum(
+        jnp.minimum(supply[:, None], capacity[None, :]), arc_cap
+    )
+    eps = _certified_eps_device(
+        F0, fb0, lifted, C=Cs, U=(unsched_cost * scale).astype(jnp.int32),
+        Uem=Uem, capacity=capacity, supply=supply, E=E, M=M,
+    )
+    eps0 = jnp.minimum(eps, eps_cap)
+    rungs = [eps0]
+    for _ in range(NUM_PHASES - 1):
+        # Iterative divide: LADDER_FACTOR ** (NUM_PHASES-1) overflows
+        # int32 as a literal operand.
+        rungs.append(jnp.maximum(rungs[-1] // LADDER_FACTOR, 1))
+    eps_sched = jnp.stack(rungs).astype(jnp.int32)
+
+    # The caller's budget bounds the WHOLE program: the full ladder gets
+    # whatever the coarse stage left, so one fused dispatch can never
+    # run materially longer than one plain cold dispatch (TPU runtime
+    # watchdog discipline — a runaway device program wedges the tunnel).
+    F, Ffb, prices, iters, bf, clean, phase_iters = _solve_device(
+        costs, supply, capacity, unsched_cost, arc_cap,
+        lifted, F0, fb0, eps_sched,
+        jnp.maximum(max_iter_total - it_c, 1), global_every, bf_max,
+        max_iter=max_iter, scale=scale,
+    )
+    return (F, Ffb, prices, iters, bf, clean, phase_iters,
+            it_c, bf_c, clean_c, eps)
+
+
+def solve_transport_coarse_fused(
+    costs: np.ndarray,
+    supply: np.ndarray,
+    capacity: np.ndarray,
+    unsched_cost: np.ndarray,
+    *,
+    arc_capacity: Optional[np.ndarray] = None,
+    max_cost_hint: Optional[int] = None,
+    max_iter_per_phase: int = 8192,
+    max_iter_total: Optional[int] = None,
+    global_update_every: int = 4,
+    bf_max: int = 64,
+    groups: Optional[int] = None,
+    pre=None,
+    force: bool = False,
+) -> Optional[TransportSolution]:
+    """One-dispatch coarse-to-fine wave solve, or ``None`` to decline.
+
+    Declines exactly like `coarse_warm_start` (small/thin instances, or
+    a greedy start that already certifies — callers then run the normal
+    path), and on a non-converged fused solve (the caller's plain cold
+    solve is the fallback; the failure is rare and the retry honest).
+    ``pre`` is a `transport.coarse_precheck` bundle — the planner
+    computes it once so a fused decline does not redo the O(E*M) host
+    work in the fallback path.
+    """
+    costs = np.asarray(costs, dtype=np.int32)
+    supply = np.asarray(supply, dtype=np.int32)
+    capacity = np.asarray(capacity, dtype=np.int32)
+    unsched_cost = np.asarray(unsched_cost, dtype=np.int32)
+    E, M = costs.shape
+    if force:
+        # Precompile mode: bypass the gates/greedy certificate and reach
+        # the device program unconditionally (the caller wants its
+        # compile key warmed, not a production decision).
+        from poseidon_tpu.ops.transport import (
+            coarse_group_count,
+            derive_scale,
+        )
+
+        e_pad, m_pad = padded_shape(E, M)
+        K = coarse_group_count(M, groups)
+        scale, _ = derive_scale(
+            costs, unsched_cost, max_cost_hint, e_pad, m_pad
+        )
+    else:
+        if pre is None:
+            pre = coarse_precheck(
+                costs, supply, capacity, arc_capacity, unsched_cost,
+                max_cost_hint, groups,
+            )
+        if pre is None:
+            return None
+        if pre["certified"]:
+            return None  # near-optimal greedy: one PLAIN dispatch wins
+        K, e_pad, m_pad, scale = (
+            pre["groups"], pre["e_pad"], pre["m_pad"], pre["scale"]
+        )
+
+    # Pad to [e_pad, K * B]: the block structure needs M divisible by K;
+    # extra columns are dead (INF cost, zero capacity) and sort last.
+    B = -(-m_pad // K)
+    M2 = K * B
+    costs_p = np.full((e_pad, M2), INF_COST, dtype=np.int32)
+    costs_p[:E, :M] = costs
+    supply_p = np.zeros(e_pad, dtype=np.int32)
+    supply_p[:E] = supply
+    unsched_p = np.ones(e_pad, dtype=np.int32)
+    unsched_p[:E] = unsched_cost
+    capacity_p = np.zeros(M2, dtype=np.int32)
+    capacity_p[:M] = capacity
+    arc_p = np.zeros((e_pad, M2), dtype=np.int32)
+    arc_p[:E, :M] = (
+        arc_capacity if arc_capacity is not None else UNBOUNDED_ARC_CAP
+    )
+
+    # Host side of the grouping: the SHARED column-sort key (dead padded
+    # columns sort last by construction).
+    perm = coarse_sort_order(costs_p).astype(np.int32)
+    inv_perm = np.argsort(perm).astype(np.int32)
+
+    # Cold ladder for the aggregated solve + the clamp for the warm one.
+    _, eps_sched_cold = _host_validate(
+        costs_p, supply_p, capacity_p, unsched_p, scale, None,
+        max_cost_hint,
+    )
+    finite = costs_p[costs_p < INF_COST]
+    max_c = int(max(finite.max() if finite.size else 1, 1)) * scale
+    if max_iter_total is None:
+        # The planner's COLD budget, shared by both in-program stages
+        # (the full ladder gets what the coarse stage leaves): one fused
+        # dispatch must stay within one plain dispatch's wall-time cap
+        # (TPU runtime watchdog).
+        max_iter_total = max_iter_per_phase
+
+    _Telemetry.device_calls += 1
+    out = _coarse_fused_device(
+        jnp.asarray(costs_p), jnp.asarray(supply_p),
+        jnp.asarray(capacity_p), jnp.asarray(unsched_p),
+        jnp.asarray(arc_p), jnp.asarray(perm), jnp.asarray(inv_perm),
+        jnp.asarray(eps_sched_cold), jnp.int32(max(max_c // 2, 1)),
+        jnp.int32(max_iter_total), jnp.int32(global_update_every),
+        jnp.int32(bf_max),
+        groups=K, block=B, max_iter=max_iter_per_phase, scale=int(scale),
+    )
+    (F, Ffb, prices, iters, bf, clean, phase_iters,
+     it_c, bf_c, clean_c, eps) = out
+    if not bool(clean_c):
+        return None  # aggregated solve aborted: no usable lift
+    flows = np.asarray(F)[:E, :M]
+    unsched = np.asarray(Ffb)[:E]
+    prices_full = np.asarray(prices)
+    prices_out = np.concatenate([
+        prices_full[:E], prices_full[e_pad:e_pad + M],
+        prices_full[e_pad + M2:],
+    ])
+    sol = _host_finalize(
+        flows, unsched, prices_out,
+        int(iters) + int(it_c),
+        costs=costs, supply=supply, capacity=capacity,
+        unsched_cost=unsched_cost, scale=scale, clean=bool(clean),
+        arc_capacity=arc_capacity, bf_sweeps=int(bf) + int(bf_c),
+        phase_iters=tuple(int(x) for x in np.asarray(phase_iters)),
+    )
+    if sol.gap_bound == float("inf"):
+        return None  # rare: callers retry the ordinary path honestly
+    return sol
