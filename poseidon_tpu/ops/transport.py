@@ -820,9 +820,10 @@ def greedy_flows(costs, supply, capacity, arc_capacity=None) -> np.ndarray:
 # that the coarse solve is cheap and (on accelerators) VMEM-resident for
 # the fused kernel, large enough that within-group cost spread — the
 # lift's certified epsilon — stays a small fraction of the cold eps0.
-# Mid-size instances (1k-2k machines) use 128 groups instead, keeping
-# the aggregation ratio >= 8 members/group (measured at 1k: K=128 cut
-# 588 -> 78 iterations); 128 is already a precompiled selective width.
+# Mid-size instances (padded machine axis under 2048, i.e. raw M up to
+# ~1.79k) use 128 groups instead, keeping the aggregation ratio >= ~7
+# members/group (measured at 1k: K=128 cut 588 -> 78 iterations); 128
+# is already a precompiled selective width.
 COARSE_GROUPS = 256
 # Below this machine count the aggregation ratio falls under ~7
 # members/group at the 128-group floor and the full solve is already
@@ -831,13 +832,17 @@ COARSE_GROUPS = 256
 COARSE_MIN_MACHINES = 896
 
 
-def coarse_group_count(m: int, groups=None) -> int:
-    """Group count for an M-machine instance: the configured cap, but
-    at least ~7 members per group (COARSE_MIN_MACHINES = 7 * 128 is the
-    floor), quantized to the two compile keys (128 / 256) precompile
-    covers."""
+def coarse_group_count(m_pad: int, groups=None) -> int:
+    """Group count for an instance whose PADDED machine axis is
+    ``m_pad``: the configured cap, but at least ~7 members per group
+    (COARSE_MIN_MACHINES = 7 * 128 is the floor), quantized to the two
+    compile keys (128 / 256) precompile covers.  Keyed on the padded
+    width — the same value precompile probes with — so the fused
+    program's (groups, block) compile key matches between precompile
+    and production (raw-M keying left e.g. 2000 machines on 128 groups
+    while the 2048-bucket probe compiled 256)."""
     cap = COARSE_GROUPS if groups is None else groups
-    return min(cap, 128 if m < 2048 else 256)
+    return min(cap, 128 if m_pad < 2048 else 256)
 
 
 def coarse_sort_order(costs) -> np.ndarray:
@@ -885,10 +890,10 @@ def coarse_precheck(costs, supply, capacity, arc_capacity, unsched_cost,
     E, M = costs.shape
     if E == 0 or M < COARSE_MIN_MACHINES:
         return None
-    K = coarse_group_count(M, groups)
+    e_pad, m_pad = padded_shape(E, M)
+    K = coarse_group_count(m_pad, groups)
     if M < 4 * K or int(supply.sum()) < 4 * K:
         return None
-    e_pad, m_pad = padded_shape(E, M)
     scale, max_raw_q = derive_scale(
         costs, unsched_cost, max_cost_hint, e_pad, m_pad
     )

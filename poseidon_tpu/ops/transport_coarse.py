@@ -248,7 +248,7 @@ def solve_transport_coarse_fused(
         )
 
         e_pad, m_pad = padded_shape(E, M)
-        K = coarse_group_count(M, groups)
+        K = coarse_group_count(m_pad, groups)
         scale, _ = derive_scale(
             costs, unsched_cost, max_cost_hint, e_pad, m_pad
         )
