@@ -129,3 +129,48 @@ def test_fused_through_planner_matches_disabled(monkeypatch):
         results[flag] = m.objective
     assert fused["n"] > 0, "fused path never produced a solution"
     assert results["0"] == results["1"], results
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_device_certificate_matches_host(seed):
+    """The in-program epsilon certificate must agree exactly with the
+    host `_certified_eps` on arbitrary feasible states — the fused full
+    ladder starts at this value, so an underestimate would silently
+    degrade the lift to an uncertified start."""
+    import jax.numpy as jnp
+
+    from poseidon_tpu.ops.transport_coarse import _certified_eps_device
+
+    rng = np.random.default_rng(seed)
+    E, M = 16, 96
+    costs = rng.integers(0, 3000, size=(E, M)).astype(np.int32)
+    costs[rng.random((E, M)) < 0.1] = T.INF_COST
+    supply = rng.integers(1, 30, size=E).astype(np.int32)
+    cap = rng.integers(1, 6, size=M).astype(np.int32)
+    unsched = rng.integers(3000, 6000, size=E).astype(np.int32)
+    arc = rng.integers(1, 5, size=(E, M)).astype(np.int32)
+    scale = 128
+
+    # An arbitrary feasible state: greedy flows + alternation duals.
+    flows = T.greedy_flows(costs, supply, cap, arc)
+    left = (supply.astype(np.int64) - flows.sum(axis=1)).astype(np.int32)
+    prices = np.concatenate([
+        rng.integers(-5000, 0, size=E),
+        rng.integers(-5000, 0, size=M),
+        [-100],
+    ]).astype(np.int32)
+
+    want = T._certified_eps(
+        flows, left, prices, costs=costs, supply=supply, capacity=cap,
+        unsched_cost=unsched, scale=scale, arc_capacity=arc,
+    )
+    Cs = np.where(costs >= T.INF_COST, T.INF_COST,
+                  costs * scale).astype(np.int32)
+    Uem = np.minimum(np.minimum(supply[:, None], cap[None, :]), arc)
+    got = int(_certified_eps_device(
+        jnp.asarray(flows), jnp.asarray(left), jnp.asarray(prices),
+        C=jnp.asarray(Cs), U=jnp.asarray(unsched * scale),
+        Uem=jnp.asarray(Uem), capacity=jnp.asarray(cap),
+        supply=jnp.asarray(supply), E=E, M=M,
+    ))
+    assert got == want, (got, want)
