@@ -50,6 +50,7 @@ from poseidon_tpu.ops.transport import (
     _Telemetry,
     coarse_precheck,
     coarse_sort_order,
+    maybe_greedy_start,
     padded_shape,
     TransportSolution,
 )
@@ -91,47 +92,33 @@ def _certified_eps_device(F, Ffb, prices, *, C, U, Uem, capacity, supply,
     jax.jit, static_argnames=("groups", "block", "max_iter", "scale")
 )
 def _coarse_fused_device(costs, supply, capacity, unsched_cost, arc_cap,
-                         perm, inv_perm, eps_sched_cold, eps_cap,
+                         perm, inv_perm, Cg, capg, arcg,
+                         seed_prices, seed_flows, seed_fb,
+                         eps_sched_coarse, eps_cap,
                          max_iter_total, global_every, bf_max,
                          *, groups, block, max_iter, scale):
     """The one-dispatch pipeline.  Shapes: costs/arc [E, M] with
     M == groups * block; perm/inv_perm [M] (host column sort into
-    contiguous similar-cost blocks); eps_sched_cold [NUM_PHASES] for the
-    aggregated solve; eps_cap scalar (max_c // 2, the ladder clamp)."""
+    contiguous similar-cost blocks); Cg/capg/arcg the host-aggregated
+    [E, K] instance (ONE aggregation definition — the host's — feeds
+    both the greedy seed and the device solve); seed_* the host's
+    greedy start for it (zeros + the cold ladder when its gate
+    declined); eps_sched_coarse [NUM_PHASES] its ladder; eps_cap
+    scalar (max_c // 2, the full ladder's clamp)."""
     E, M = costs.shape
     K, B = groups, block
 
-    # ---- block views in sorted column space
+    # ---- block views in sorted column space (for the disaggregation)
     costs_s = jnp.take(costs, perm, axis=1).reshape(E, K, B)
     cap_s = jnp.take(capacity, perm).reshape(K, B)
     arc_s = jnp.take(arc_cap, perm, axis=1).reshape(E, K, B)
     adm_s = costs_s < INF_COST
 
-    # ---- aggregation: admissible-mean costs, summed capacities
-    n_adm = jnp.sum(adm_s, axis=-1)                          # [E, K]
-    csum = jnp.sum(jnp.where(adm_s, costs_s, 0), axis=-1)    # raw costs
-    # COST_CAP (2^14) x block keeps the int32 cost sum exact; round
-    # half-up.
-    Cg = jnp.where(
-        n_adm > 0,
-        (csum + n_adm // 2) // jnp.maximum(n_adm, 1),
-        INF_COST,
-    ).astype(jnp.int32)
-    # Per-member clip scaled by the block size so the int32 block SUM is
-    # exact at any B, while "effectively unbounded" group capacities
-    # stay far above any feasible supply (flow mass < 2^31, validated).
-    lim = (1 << 29) // B
-    capg = jnp.sum(jnp.minimum(cap_s, lim), axis=-1)
-    arcg = jnp.sum(
-        jnp.minimum(jnp.where(adm_s, arc_s, 0), lim), axis=-1
-    ).astype(jnp.int32)
-
-    # ---- coarse ladder at [E, K] (cold: zero prices/flows)
-    zK = jnp.zeros(E + K + 1, dtype=jnp.int32)
+    # ---- coarse ladder at [E, K] from the host seed
     Fc, Ffb_c, prices_c, it_c, bf_c, clean_c, _pi = _solve_device(
-        Cg, supply, capg.astype(jnp.int32), unsched_cost, arcg,
-        zK, jnp.zeros((E, K), jnp.int32), jnp.zeros(E, jnp.int32),
-        eps_sched_cold, max_iter_total, global_every, bf_max,
+        Cg, supply, capg, unsched_cost, arcg,
+        seed_prices, seed_flows, seed_fb,
+        eps_sched_coarse, max_iter_total, global_every, bf_max,
         max_iter=max_iter, scale=scale,
     )
 
@@ -288,10 +275,48 @@ def solve_transport_coarse_fused(
     perm = coarse_sort_order(costs_p).astype(np.int32)
     inv_perm = np.argsort(perm).astype(np.int32)
 
-    # Cold ladder for the aggregated solve + the clamp for the warm one.
-    _, eps_sched_cold = _host_validate(
+    # FULL-instance validation first (the guards solve_transport applies
+    # to every instance — raw-cost bounds, non-negativity, int32
+    # flow-mass headroom for the full-width push cumsums): the fused
+    # path runs the unclipped full instance in its second stage, so an
+    # aggregated-only check would silently skip them.
+    _host_validate(
         costs_p, supply_p, capacity_p, unsched_p, scale, None,
         max_cost_hint,
+    )
+
+    # Greedy seed for the IN-PROGRAM coarse stage: the ONE aggregation
+    # (host reshape-sums over the sorted blocks) feeds both the seed and
+    # the device solve as operands.  Without the seed the fused coarse
+    # stage starts cold and pays 2-3x the iterations — per-op cost is
+    # exactly the term the H1 hypothesis says dominates on the tunneled
+    # accelerator.
+    costs_srt = costs_p[:, perm].reshape(e_pad, K, B)
+    adm_srt = costs_srt < INF_COST
+    n_adm = adm_srt.sum(axis=-1)
+    csum = np.where(adm_srt, costs_srt, 0).sum(axis=-1, dtype=np.int64)
+    Cg_h = np.where(
+        n_adm > 0, (csum + n_adm // 2) // np.maximum(n_adm, 1), INF_COST
+    ).astype(np.int32)
+    # Per-member clip scaled by the block size keeps the int32 sums
+    # exact at any B while "effectively unbounded" group capacities stay
+    # far above any feasible supply.
+    lim = (1 << 29) // B
+    capg_h = np.minimum(
+        capacity_p[perm].reshape(K, B), lim
+    ).sum(axis=-1).astype(np.int32)
+    arcg_h = np.minimum(
+        np.where(adm_srt, arc_p[:, perm].reshape(e_pad, K, B), 0), lim
+    ).sum(axis=-1).astype(np.int32)
+    gf_c, gfb_c, gp_c, geps_c = maybe_greedy_start(
+        True, None, None, None, None, Cg_h, supply_p, capg_h, arcg_h,
+        unsched_p, max_cost_hint, e_pad, K, scale=scale,
+    )
+    if gp_c is None:
+        gp_c = np.zeros(e_pad + K + 1, dtype=np.int32)
+        geps_c = None  # cold ladder below
+    _, eps_sched_coarse = _host_validate(
+        Cg_h, supply_p, capg_h, unsched_p, scale, geps_c, max_cost_hint,
     )
     finite = costs_p[costs_p < INF_COST]
     max_c = int(max(finite.max() if finite.size else 1, 1)) * scale
@@ -307,7 +332,10 @@ def solve_transport_coarse_fused(
         jnp.asarray(costs_p), jnp.asarray(supply_p),
         jnp.asarray(capacity_p), jnp.asarray(unsched_p),
         jnp.asarray(arc_p), jnp.asarray(perm), jnp.asarray(inv_perm),
-        jnp.asarray(eps_sched_cold), jnp.int32(max(max_c // 2, 1)),
+        jnp.asarray(Cg_h), jnp.asarray(capg_h), jnp.asarray(arcg_h),
+        jnp.asarray(gp_c), jnp.asarray(gf_c.astype(np.int32)),
+        jnp.asarray(gfb_c.astype(np.int32)),
+        jnp.asarray(eps_sched_coarse), jnp.int32(max(max_c // 2, 1)),
         jnp.int32(max_iter_total), jnp.int32(global_update_every),
         jnp.int32(bf_max),
         groups=K, block=B, max_iter=max_iter_per_phase, scale=int(scale),

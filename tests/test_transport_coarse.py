@@ -174,3 +174,16 @@ def test_device_certificate_matches_host(seed):
         supply=jnp.asarray(supply), E=E, M=M,
     ))
     assert got == want, (got, want)
+
+
+def test_fused_rejects_flow_mass_overflow(small_gates):
+    """The fused path validates the FULL instance (its second stage runs
+    the unclipped full-width push cumsums): int32 flow-mass overflow
+    must raise exactly as in solve_transport, not silently aggregate
+    past the guard."""
+    costs, supply, cap, unsched, arc = _instance(12, 1200, seed=3)
+    huge = np.full(1200, (1 << 30), dtype=np.int32)
+    with pytest.raises(ValueError):
+        solve_transport_coarse_fused(
+            costs, supply, huge, unsched, arc_capacity=arc,
+        )
