@@ -98,26 +98,30 @@ def _cumsum_rows(x):
 def _phase_ladder_kernel(
     # scalar-prefetch / SMEM operands
     eps_ref,      # SMEM [NUM_PHASES] epsilon ladder
-    knobs_ref,    # SMEM [4]: max_iter, max_iter_total, global_every, bf_max
+    knobs_ref,    # SMEM [5]: max_iter, max_iter_total, global_every,
+                  #           bf_max, total supply
     # VMEM inputs
     C_ref,        # [E, M] scaled costs (INF_COST marks inadmissible)
     U_ref,        # [E, 1] scaled unscheduled costs
     sup_ref,      # [E, 1] supplies
     cap_ref,      # [1, M] column capacities
     Uem_ref,      # [E, M] per-arc capacity
-    tot_ref,      # [1, 1] total supply
     F0_ref, Ffb0_ref, Fmt0_ref, pe0_ref, pm0_ref, pt0_ref,
-    # VMEM outputs
+    # outputs (VMEM except the SMEM scalar blocks)
     F_out, Ffb_out, pe_out, pm_out, pt_out, stats_out, phase_out,
 ):
     """The whole ladder in one kernel.
 
     State lives in the output refs (mutated in place across phases); loop
     carries are scalars only, which is what Mosaic handles best.
-    ``stats_out`` is [1, 4]: iterations, bf sweeps, clean flag, and the
+    ``stats_out`` is SMEM [4]: iterations, bf sweeps, clean flag, and the
     Fmt sink-arc column total is NOT needed outside (recomputed by the
-    host from F) so slot 3 is reserved/zero.  ``phase_out`` is
-    [1, NUM_PHASES] per-phase iteration counts.
+    host from F) so slot 3 is reserved/zero.  ``phase_out`` is SMEM
+    [NUM_PHASES] per-phase iteration counts.  Scalar results live in
+    SMEM because Mosaic rejects scalar stores to VMEM refs (observed on
+    a real v5e: "Cannot store scalars to VMEM"); the total supply rides
+    the SMEM knobs vector for the same reason (scalar *loads* from a
+    [1, 1] VMEM block are equally unsupported).
     """
     E, M = C_ref.shape
     C = C_ref[:]
@@ -126,11 +130,11 @@ def _phase_ladder_kernel(
     supply = sup_ref[:]
     cap = cap_ref[:]
     Uem = Uem_ref[:]
-    total = tot_ref[0, 0]
     max_iter = knobs_ref[0]
     max_iter_total = knobs_ref[1]
     global_every = knobs_ref[2]
     bf_max = knobs_ref[3]
+    total = knobs_ref[4]
 
     # Working state starts in the output refs.
     F_out[:] = F0_ref[:]
@@ -428,7 +432,7 @@ def _phase_ladder_kernel(
             pe_out[:] = pe
             pm_out[:] = pm
             pt_out[:] = pt
-            phase_out[0, k] = iters
+            phase_out[k] = iters
             return tot_it + iters, tot_bf + bf
 
         tot_it, tot_bf = lax.fori_loop(
@@ -439,10 +443,10 @@ def _phase_ladder_kernel(
         clean = (
             jnp.all(exc_e == 0) & jnp.all(exc_m == 0) & (exc_t == 0)
         )
-        stats_out[0, 0] = tot_it
-        stats_out[0, 1] = tot_bf
-        stats_out[0, 2] = clean.astype(jnp.int32)
-        stats_out[0, 3] = jnp.int32(0)
+        stats_out[0] = tot_it
+        stats_out[1] = tot_bf
+        stats_out[2] = clean.astype(jnp.int32)
+        stats_out[3] = jnp.int32(0)
 
     pl.run_scoped(_ladder, pltpu.VMEM((1, M), jnp.int32))
 
@@ -509,6 +513,7 @@ def solve_device_fused(costs, supply, capacity, unsched_cost, arc_cap,
         jnp.asarray(max_iter_total, jnp.int32),
         jnp.asarray(global_every, jnp.int32),
         jnp.asarray(bf_max, jnp.int32),
+        total.astype(jnp.int32),
     ])
 
     out_shapes = (
@@ -517,20 +522,21 @@ def solve_device_fused(costs, supply, capacity, unsched_cost, arc_cap,
         jax.ShapeDtypeStruct((Ek, 1), jnp.int32),           # pe
         jax.ShapeDtypeStruct((1, Mk), jnp.int32),           # pm
         jax.ShapeDtypeStruct((1, 1), jnp.int32),            # pt
-        jax.ShapeDtypeStruct((1, 4), jnp.int32),            # stats
-        jax.ShapeDtypeStruct((1, NUM_PHASES), jnp.int32),   # phase iters
+        jax.ShapeDtypeStruct((4,), jnp.int32),              # stats (SMEM)
+        jax.ShapeDtypeStruct((NUM_PHASES,), jnp.int32),     # phase (SMEM)
     )
     vm = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
+    sm = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
     F, Ffb, pe_o, pm_o, pt_o, stats, phase_iters = pl.pallas_call(
         _phase_ladder_kernel,
         out_shape=out_shapes,
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),   # eps_sched
-            pl.BlockSpec(memory_space=pltpu.SMEM),   # knobs
-            vm(), vm(), vm(), vm(), vm(), vm(),      # C U sup cap Uem tot
+            sm(),                                    # eps_sched
+            sm(),                                    # knobs
+            vm(), vm(), vm(), vm(), vm(),            # C U sup cap Uem
             vm(), vm(), vm(), vm(), vm(), vm(),      # F0 Ffb0 Fmt0 pe pm pt
         ],
-        out_specs=tuple(vm() for _ in out_shapes),
+        out_specs=(vm(), vm(), vm(), vm(), vm(), sm(), sm()),
         interpret=interpret,
     )(
         eps_sched.astype(jnp.int32),
@@ -540,7 +546,6 @@ def solve_device_fused(costs, supply, capacity, unsched_cost, arc_cap,
         supply_k[:, None],
         cap_k[None, :],
         Uem,
-        total.reshape(1, 1).astype(jnp.int32),
         F0,
         Ffb0[:, None],
         Fmt0[None, :],
@@ -553,6 +558,6 @@ def solve_device_fused(costs, supply, capacity, unsched_cost, arc_cap,
     )
     return (
         F[:E, :M], Ffb[:E, 0], prices,
-        stats[0, 0], stats[0, 1], stats[0, 2].astype(jnp.bool_),
-        phase_iters[0],
+        stats[0], stats[1], stats[2].astype(jnp.bool_),
+        phase_iters,
     )
