@@ -1,0 +1,122 @@
+"""Separate the tunnel's per-transfer latency from its bandwidth.
+
+The round-5 live profile (out/tpu_profile_1k.txt) showed a [100x1000]
+i32 upload at ~60 ms and download at ~116 ms — either a ~60 ms/transfer
+round-trip floor (cure: FEWER transfers — batch operands, device-resident
+state) or a ~3-7 MB/s pipe (cure: SMALLER transfers — narrow dtypes,
+compact results).  This probe times device_put / np.asarray across a
+size ladder and fits time = latency + bytes/bandwidth, and also measures
+whether N separate small buffers cost N round trips or one (the operand-
+batching question: a solve ships ~8 operands per dispatch).
+
+Usage: python tools/profile_transfer.py [--reps 7]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def p50(xs):
+    return float(np.percentile(xs, 50))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=7)
+    args = ap.parse_args()
+
+    from poseidon_tpu.utils.envutil import (
+        probe_device_count,
+        serialize_device_access,
+    )
+
+    if not serialize_device_access():
+        print("device lock busy; not contending for the accelerator",
+              flush=True)
+        raise SystemExit(2)
+    if probe_device_count(timeout=300.0) < 0:
+        print("backend unreachable (wedged tunnel?); aborting", flush=True)
+        raise SystemExit(2)
+
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print(f"backend: {jax.default_backend()} ({dev.device_kind})",
+          flush=True)
+
+    # --- size ladder: one buffer per transfer --------------------------
+    sizes = [(8, 128), (64, 512), (100, 1000), (256, 2048),
+             (256, 10240), (512, 10240)]
+    rows = []
+    for (e, m) in sizes:
+        x = np.arange(e * m, dtype=np.int32).reshape(e, m)
+        ups, downs = [], []
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            xd = jax.device_put(x, dev)
+            xd.block_until_ready()
+            ups.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            np.asarray(xd)
+            downs.append(time.perf_counter() - t0)
+        mb = x.nbytes / 1e6
+        rows.append((mb, p50(ups), p50(downs)))
+        print(f"[{e}x{m}] {mb:7.2f} MB  up p50 {p50(ups)*1e3:8.1f} ms"
+              f"  down p50 {p50(downs)*1e3:8.1f} ms", flush=True)
+
+    # Least-squares fit time = a + b*MB on the p50s.
+    A = np.vstack([np.ones(len(rows)), [r[0] for r in rows]]).T
+    for name, col in (("upload", 1), ("download", 2)):
+        coef, *_ = np.linalg.lstsq(A, [r[col] for r in rows], rcond=None)
+        lat_ms, s_per_mb = coef[0] * 1e3, coef[1]
+        bw = (1.0 / s_per_mb) if s_per_mb > 1e-9 else float("inf")
+        print(f"{name}: latency ~{lat_ms:.1f} ms/transfer, "
+              f"bandwidth ~{bw:.1f} MB/s", flush=True)
+
+    # --- operand batching: 8 small buffers vs 1 equal-size buffer ------
+    n_ops = 8
+    small = [np.arange(100 * 1000, dtype=np.int32).reshape(100, 1000)
+             for _ in range(n_ops)]
+    big = np.arange(n_ops * 100 * 1000, dtype=np.int32)
+    many, one = [], []
+    for _ in range(args.reps):
+        t0 = time.perf_counter()
+        ds = [jax.device_put(s, dev) for s in small]
+        for d in ds:
+            d.block_until_ready()
+        many.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        jax.device_put(big, dev).block_until_ready()
+        one.append(time.perf_counter() - t0)
+    print(f"{n_ops} x 0.4 MB buffers p50 {p50(many)*1e3:.1f} ms vs "
+          f"one {big.nbytes/1e6:.1f} MB buffer p50 {p50(one)*1e3:.1f} ms",
+          flush=True)
+
+    # --- does a dispatch on device-RESIDENT operands avoid the floor? --
+    f = jax.jit(lambda a, b: (a + b).sum())
+    xd = jax.device_put(small[0], dev)
+    yd = jax.device_put(small[0], dev)
+    f(xd, yd).block_until_ready()           # compile
+    resident, from_host = [], []
+    for _ in range(args.reps):
+        t0 = time.perf_counter()
+        f(xd, yd).block_until_ready()
+        resident.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        f(small[0], small[0]).block_until_ready()
+        from_host.append(time.perf_counter() - t0)
+    print(f"jit on resident operands p50 {p50(resident)*1e3:.1f} ms; "
+          f"same jit fed numpy p50 {p50(from_host)*1e3:.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()
