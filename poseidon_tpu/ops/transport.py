@@ -616,6 +616,67 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
 _FUSED_BROKEN: set = set()
 _TILED_BROKEN: set = set()
 
+
+@functools.partial(
+    jax.jit, static_argnames=("max_iter", "scale", "impl", "interpret")
+)
+def _solve_device_packed(big, vec, *, max_iter, scale, impl,
+                         interpret=False):
+    """Packed-I/O twin of the three solve variants.
+
+    The production TPU sits behind a tunnel whose per-transfer round
+    trip (~60-116 ms measured, tools/profile_transfer.py) dwarfs its
+    marginal bandwidth cost at solver sizes: the unpacked dispatch's 12
+    uploads + 7 fetches put a ~1.8 s floor under a ZERO-iteration churn
+    round (the whole round-5 TPU churn p50).  This wrapper takes two
+    buffers — ``big`` [3, E_pad, M_pad] int32 (costs, arc capacity,
+    init flows) and ``vec`` 1-D int32 (supply | capacity | unsched cost
+    | prices | fallback | eps schedule | max_iter_total, global_every,
+    bf_max) — and returns two (the flow matrix and one small vector:
+    fallback | prices | iters, bf, clean | per-phase iterations), so a
+    solve costs 2 uploads + 2 fetches regardless of implementation.
+    The unpack/repack runs on device inside the jit (slices fuse into
+    the consumers; no extra HBM traffic).
+    """
+    _, E, M = big.shape
+    costs = big[0]
+    arc_cap = big[1]
+    init_flows = big[2]
+    o = 0
+    supply = vec[o:o + E]; o += E                       # noqa: E702
+    capacity = vec[o:o + M]; o += M                     # noqa: E702
+    unsched_cost = vec[o:o + E]; o += E                 # noqa: E702
+    init_prices = vec[o:o + E + M + 1]; o += E + M + 1  # noqa: E702
+    init_fb = vec[o:o + E]; o += E                      # noqa: E702
+    eps_sched = vec[o:o + NUM_PHASES]; o += NUM_PHASES  # noqa: E702
+    max_iter_total = vec[o]
+    global_every = vec[o + 1]
+    bf_max = vec[o + 2]
+    args = (costs, supply, capacity, unsched_cost, arc_cap, init_prices,
+            init_flows, init_fb, eps_sched, max_iter_total, global_every,
+            bf_max)
+    if impl == "fused":
+        from poseidon_tpu.ops.transport_fused import solve_device_fused
+
+        out = solve_device_fused(*args, max_iter=max_iter, scale=scale,
+                                 interpret=interpret)
+    elif impl == "tiled":
+        from poseidon_tpu.ops.transport_tiled import solve_device_tiled
+
+        out = solve_device_tiled(*args, max_iter=max_iter, scale=scale,
+                                 interpret=interpret)
+    else:
+        out = _solve_device(*args, max_iter=max_iter, scale=scale)
+    F, Ffb, prices, iters, bf, clean, phase_iters = out
+    small = jnp.concatenate([
+        Ffb.astype(jnp.int32),
+        prices.astype(jnp.int32),
+        jnp.stack([iters.astype(jnp.int32), bf.astype(jnp.int32),
+                   clean.astype(jnp.int32)]),
+        phase_iters.astype(jnp.int32),
+    ])
+    return F, small
+
 # Platforms where device-side fixed costs (kernel launches, loop-step
 # syncs, per-dispatch tunnel round trips) dominate small-array work —
 # the backends the Pallas kernels and dispatch-count policies target.
@@ -1403,7 +1464,12 @@ def solve_transport(
     # zero supply; padded columns have zero capacity and no admissible
     # arcs — both inert.
     E_pad, M_pad = padded_shape(E, M)
-    costs_p = np.full((E_pad, M_pad), INF_COST, dtype=np.int32)
+    # The three [E_pad, M_pad] operands live as planes of ONE buffer so
+    # the dispatch ships them in a single tunnel transfer (see
+    # _solve_device_packed); host code below works on the views.
+    big = np.empty((3, E_pad, M_pad), dtype=np.int32)
+    costs_p, arc_p, flows_p = big[0], big[1], big[2]
+    costs_p.fill(INF_COST)
     costs_p[:E, :M] = costs
     supply_p = np.zeros(E_pad, dtype=np.int32)
     supply_p[:E] = supply
@@ -1434,13 +1500,14 @@ def solve_transport(
         prices_p[E_pad:E_pad + M] = init_prices[E:E + M]
         prices_p[E_pad + M_pad] = init_prices[E + M]
 
-    arc_p = np.zeros((E_pad, M_pad), dtype=np.int32)
     if arc_capacity is not None:
+        arc_p.fill(0)
         arc_p[:E, :M] = arc_capacity
     else:
+        arc_p.fill(0)
         arc_p[:E, :M] = UNBOUNDED_ARC_CAP
 
-    flows_p = np.zeros((E_pad, M_pad), dtype=np.int32)
+    flows_p.fill(0)
     if init_flows is not None:
         flows_p[:E, :M] = init_flows
     fb_p = np.zeros(E_pad, dtype=np.int32)
@@ -1450,26 +1517,24 @@ def solve_transport(
     if max_iter_total is None:
         max_iter_total = NUM_PHASES * max_iter_per_phase
     _Telemetry.device_calls += 1
-    operands = (
-        jnp.asarray(costs_p), jnp.asarray(supply_p), jnp.asarray(capacity_p),
-        jnp.asarray(unsched_p), jnp.asarray(arc_p),
-        jnp.asarray(prices_p),
-        jnp.asarray(flows_p),
-        jnp.asarray(fb_p),
-        jnp.asarray(eps_sched),
-        jnp.int32(max_iter_total),
-        jnp.int32(global_update_every),
-        jnp.int32(bf_max),
-    )
-    def _try_pallas(solve_fn, kernel_name, latch_name):
+    vec = np.concatenate([
+        supply_p, capacity_p, unsched_p, prices_p, fb_p,
+        np.asarray(eps_sched, dtype=np.int32),
+        np.asarray(
+            [max_iter_total, global_update_every, bf_max], dtype=np.int32
+        ),
+    ])
+
+    def _try_pallas(impl, latch_name):
         # A backend whose Mosaic lowering rejects a kernel must degrade
         # to the (mathematically identical) lax path, not fail solves.
         # Once broken, stay off FOR THIS SHAPE: Pallas programs compile
         # per padded shape, so one shape's lowering failure (e.g. VMEM
         # overflow at an alignment edge) says nothing about the others.
         try:
-            return solve_fn(
-                *operands, max_iter=max_iter_per_phase, scale=int(scale),
+            return _solve_device_packed(
+                big, vec, max_iter=max_iter_per_phase, scale=int(scale),
+                impl=impl,
                 # Interpret mode on hosts without a Mosaic backend
                 # (tests / CPU with POSEIDON_FUSED/TILED=1); compiled on
                 # the accelerator.
@@ -1481,28 +1546,31 @@ def solve_transport(
 
             logging.getLogger("poseidon_tpu.transport").error(
                 "%s Pallas kernel unavailable for shape [%d, %d] on this "
-                "backend (%s: %s); using the lax path", kernel_name,
+                "backend (%s: %s); using the lax path", impl,
                 E_pad, M_pad, type(e).__name__, e,
             )
             return None
 
     out = None
     if _use_fused(E_pad, M_pad):
-        from poseidon_tpu.ops.transport_fused import solve_device_fused
-
-        out = _try_pallas(solve_device_fused, "fused", "_FUSED_BROKEN")
+        out = _try_pallas("fused", "_FUSED_BROKEN")
     elif _use_tiled(E_pad, M_pad):
-        from poseidon_tpu.ops.transport_tiled import solve_device_tiled
-
-        out = _try_pallas(solve_device_tiled, "tiled", "_TILED_BROKEN")
+        out = _try_pallas("tiled", "_TILED_BROKEN")
     if out is None:
-        out = _solve_device(
-            *operands, max_iter=max_iter_per_phase, scale=int(scale)
+        out = _solve_device_packed(
+            big, vec, max_iter=max_iter_per_phase, scale=int(scale),
+            impl="lax",
         )
-    flows, unsched, prices, iters, bf, clean, phase_iters = out
-    flows = np.asarray(flows)[:E, :M]
-    unsched = np.asarray(unsched)[:E]
-    prices_full = np.asarray(prices)
+    F_dev, small_dev = out
+    flows = np.asarray(F_dev)[:E, :M]
+    small = np.asarray(small_dev)
+    o = E_pad
+    unsched = small[:E]
+    prices_full = small[o:o + E_pad + M_pad + 1]
+    o += E_pad + M_pad + 1
+    iters, bf, clean = (int(small[o]), int(small[o + 1]),
+                        bool(small[o + 2]))
+    phase_iters = small[o + 3:o + 3 + NUM_PHASES]
     prices_out = np.concatenate([
         prices_full[:E], prices_full[E_pad:E_pad + M],
         prices_full[E_pad + M_pad:],
@@ -1511,8 +1579,8 @@ def solve_transport(
         flows, unsched, prices_out, iters,
         costs=costs, supply=supply, capacity=capacity,
         unsched_cost=unsched_cost, scale=scale, clean=clean,
-        arc_capacity=arc_capacity, bf_sweeps=int(bf),
-        phase_iters=tuple(int(x) for x in np.asarray(phase_iters)),
+        arc_capacity=arc_capacity, bf_sweeps=bf,
+        phase_iters=tuple(int(x) for x in phase_iters),
     )
 
 

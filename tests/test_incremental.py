@@ -170,16 +170,19 @@ def test_unknown_flow_solver_rejected():
 def test_precompile_covers_round_shapes():
     """After precompile(), a first scheduling round must not add compile
     keys (the server's precompile flag, FirmamentTPUConfig.precompile)."""
-    from poseidon_tpu.ops.transport import _solve_device
+    # The packed wrapper is the dispatch boundary (the inner solve
+    # variants inline into its trace and mint no executables of their
+    # own), so its cache is where a missed precompile key would show.
+    from poseidon_tpu.ops.transport import _solve_device_packed
 
     st = make_state(num_machines=40, num_tasks=60, seed=13)
     planner = RoundPlanner(st, get_cost_model("cpu_mem"))
     shapes = planner.precompile(max_ecs=64)
     assert shapes >= 3
-    before = _solve_device._cache_size()
+    before = _solve_device_packed._cache_size()
     _, metrics = planner.schedule_round()
     assert metrics.placed > 0
-    assert _solve_device._cache_size() == before
+    assert _solve_device_packed._cache_size() == before
 
 
 def test_resubmission_affinity_returns_tasks_to_prior_machines():
