@@ -91,22 +91,43 @@ def _certified_eps_device(F, Ffb, prices, *, C, U, Uem, capacity, supply,
 @functools.partial(
     jax.jit, static_argnames=("groups", "block", "max_iter", "scale")
 )
-def _coarse_fused_device(costs, supply, capacity, unsched_cost, arc_cap,
-                         perm, inv_perm, Cg, capg, arcg,
-                         seed_prices, seed_flows, seed_fb,
-                         eps_sched_coarse, eps_cap,
-                         max_iter_total, global_every, bf_max,
+def _coarse_fused_device(big, coarse3, vec,
                          *, groups, block, max_iter, scale):
-    """The one-dispatch pipeline.  Shapes: costs/arc [E, M] with
-    M == groups * block; perm/inv_perm [M] (host column sort into
-    contiguous similar-cost blocks); Cg/capg/arcg the host-aggregated
-    [E, K] instance (ONE aggregation definition — the host's — feeds
-    both the greedy seed and the device solve); seed_* the host's
-    greedy start for it (zeros + the cold ladder when its gate
-    declined); eps_sched_coarse [NUM_PHASES] its ladder; eps_cap
-    scalar (max_c // 2, the full ladder's clamp)."""
-    E, M = costs.shape
+    """The one-dispatch pipeline, packed-I/O (the tunnel's per-transfer
+    round trip is the wave's dominant fixed cost — see
+    transport._solve_device_packed).  ``big`` [2, E, M] carries costs
+    and arc capacity (M == groups * block); ``coarse3`` [3, E, K] the
+    host-aggregated instance (costs, arc caps, greedy seed flows — ONE
+    aggregation definition, the host's, feeds both the seed and the
+    device solve); ``vec`` 1-D int32 packs supply | capacity | unsched
+    | perm | inv_perm (host column sort into contiguous similar-cost
+    blocks) | coarse capacity | coarse seed prices (zeros + cold ladder
+    when the greedy gate declined) | coarse seed fallback | the coarse
+    epsilon ladder | [eps_cap (max_c // 2, the full ladder's clamp),
+    max_iter_total, global_every, bf_max].  Returns the flow matrix
+    plus one packed vector (fallback | prices | 7 scalars | per-phase
+    iterations)."""
+    _, E, M = big.shape
     K, B = groups, block
+    costs = big[0]
+    arc_cap = big[1]
+    Cg = coarse3[0]
+    arcg = coarse3[1]
+    seed_flows = coarse3[2]
+    o = 0
+    supply = vec[o:o + E]; o += E                         # noqa: E702
+    capacity = vec[o:o + M]; o += M                       # noqa: E702
+    unsched_cost = vec[o:o + E]; o += E                   # noqa: E702
+    perm = vec[o:o + M]; o += M                           # noqa: E702
+    inv_perm = vec[o:o + M]; o += M                       # noqa: E702
+    capg = vec[o:o + K]; o += K                           # noqa: E702
+    seed_prices = vec[o:o + E + K + 1]; o += E + K + 1    # noqa: E702
+    seed_fb = vec[o:o + E]; o += E                        # noqa: E702
+    eps_sched_coarse = vec[o:o + NUM_PHASES]; o += NUM_PHASES  # noqa: E702
+    eps_cap = vec[o]
+    max_iter_total = vec[o + 1]
+    global_every = vec[o + 2]
+    bf_max = vec[o + 3]
 
     # ---- block views in sorted column space (for the disaggregation)
     costs_s = jnp.take(costs, perm, axis=1).reshape(E, K, B)
@@ -190,8 +211,18 @@ def _coarse_fused_device(costs, supply, capacity, unsched_cost, arc_cap,
         jnp.maximum(max_iter_total - it_c, 1), global_every, bf_max,
         max_iter=max_iter, scale=scale,
     )
-    return (F, Ffb, prices, iters, bf, clean, phase_iters,
-            it_c, bf_c, clean_c, eps)
+    small = jnp.concatenate([
+        Ffb.astype(jnp.int32),
+        prices.astype(jnp.int32),
+        jnp.stack([
+            iters.astype(jnp.int32), bf.astype(jnp.int32),
+            clean.astype(jnp.int32), it_c.astype(jnp.int32),
+            bf_c.astype(jnp.int32), clean_c.astype(jnp.int32),
+            eps.astype(jnp.int32),
+        ]),
+        phase_iters.astype(jnp.int32),
+    ])
+    return F, small
 
 
 def solve_transport_coarse_fused(
@@ -257,7 +288,10 @@ def solve_transport_coarse_fused(
     # extra columns are dead (INF cost, zero capacity) and sort last.
     B = -(-m_pad // K)
     M2 = K * B
-    costs_p = np.full((e_pad, M2), INF_COST, dtype=np.int32)
+    # costs/arc ride planes of one buffer (one tunnel upload).
+    big = np.empty((2, e_pad, M2), dtype=np.int32)
+    costs_p, arc_p = big[0], big[1]
+    costs_p.fill(INF_COST)
     costs_p[:E, :M] = costs
     supply_p = np.zeros(e_pad, dtype=np.int32)
     supply_p[:E] = supply
@@ -265,7 +299,7 @@ def solve_transport_coarse_fused(
     unsched_p[:E] = unsched_cost
     capacity_p = np.zeros(M2, dtype=np.int32)
     capacity_p[:M] = capacity
-    arc_p = np.zeros((e_pad, M2), dtype=np.int32)
+    arc_p.fill(0)
     arc_p[:E, :M] = (
         arc_capacity if arc_capacity is not None else UNBOUNDED_ARC_CAP
     )
@@ -328,36 +362,49 @@ def solve_transport_coarse_fused(
         max_iter_total = max_iter_per_phase
 
     _Telemetry.device_calls += 1
-    out = _coarse_fused_device(
-        jnp.asarray(costs_p), jnp.asarray(supply_p),
-        jnp.asarray(capacity_p), jnp.asarray(unsched_p),
-        jnp.asarray(arc_p), jnp.asarray(perm), jnp.asarray(inv_perm),
-        jnp.asarray(Cg_h), jnp.asarray(capg_h), jnp.asarray(arcg_h),
-        jnp.asarray(gp_c), jnp.asarray(gf_c.astype(np.int32)),
-        jnp.asarray(gfb_c.astype(np.int32)),
-        jnp.asarray(eps_sched_coarse), jnp.int32(max(max_c // 2, 1)),
-        jnp.int32(max_iter_total), jnp.int32(global_update_every),
-        jnp.int32(bf_max),
+    coarse3 = np.empty((3, e_pad, K), dtype=np.int32)
+    coarse3[0] = Cg_h
+    coarse3[1] = arcg_h
+    coarse3[2] = gf_c
+    vec = np.concatenate([
+        supply_p, capacity_p, unsched_p, perm, inv_perm, capg_h,
+        gp_c.astype(np.int32), gfb_c.astype(np.int32),
+        np.asarray(eps_sched_coarse, dtype=np.int32),
+        np.asarray(
+            [max(max_c // 2, 1), max_iter_total, global_update_every,
+             bf_max],
+            dtype=np.int32,
+        ),
+    ])
+    F_dev, small_dev = _coarse_fused_device(
+        big, coarse3, vec,
         groups=K, block=B, max_iter=max_iter_per_phase, scale=int(scale),
     )
-    (F, Ffb, prices, iters, bf, clean, phase_iters,
-     it_c, bf_c, clean_c, eps) = out
-    if not bool(clean_c):
+    # One fetch decides the decline before the (large) flow fetch.
+    small = np.asarray(small_dev)
+    o = e_pad + (e_pad + M2 + 1)
+    iters, bf, clean, it_c, bf_c, clean_c, eps = (
+        int(small[o]), int(small[o + 1]), bool(small[o + 2]),
+        int(small[o + 3]), int(small[o + 4]), bool(small[o + 5]),
+        int(small[o + 6]),
+    )
+    phase_iters = small[o + 7:o + 7 + NUM_PHASES]
+    if not clean_c:
         return None  # aggregated solve aborted: no usable lift
-    flows = np.asarray(F)[:E, :M]
-    unsched = np.asarray(Ffb)[:E]
-    prices_full = np.asarray(prices)
+    flows = np.asarray(F_dev)[:E, :M]
+    unsched = small[:E]
+    prices_full = small[e_pad:e_pad + e_pad + M2 + 1]
     prices_out = np.concatenate([
         prices_full[:E], prices_full[e_pad:e_pad + M],
         prices_full[e_pad + M2:],
     ])
     sol = _host_finalize(
         flows, unsched, prices_out,
-        int(iters) + int(it_c),
+        iters + it_c,
         costs=costs, supply=supply, capacity=capacity,
-        unsched_cost=unsched_cost, scale=scale, clean=bool(clean),
-        arc_capacity=arc_capacity, bf_sweeps=int(bf) + int(bf_c),
-        phase_iters=tuple(int(x) for x in np.asarray(phase_iters)),
+        unsched_cost=unsched_cost, scale=scale, clean=clean,
+        arc_capacity=arc_capacity, bf_sweeps=bf + bf_c,
+        phase_iters=tuple(int(x) for x in phase_iters),
     )
     if sol.gap_bound == float("inf"):
         return None  # rare: callers retry the ordinary path honestly
