@@ -685,6 +685,84 @@ def _solve_device_packed(big, vec, *, max_iter, scale, impl,
     ])
     return F, small
 
+
+# ---------------------------------------------------------------- resident
+# Device-resident operand cache: on the tunneled accelerator the [3, E, M]
+# operand buffer is the dominant upload of every round, yet between churn
+# rounds only the columns whose machines gained/lost load actually change.
+# The cache keeps the last shipped buffer per padded shape (host copy +
+# device handle) and ships only the changed columns (scatter on device);
+# the solve's flow result is folded into the resident plane 2 device-side,
+# so a steady-state round uploads a few columns and downloads nothing.
+_RESIDENT: dict = {}
+_RESIDENT_MAX_SHAPES = 4
+# When more than M_pad // DIVISOR columns changed, a wholesale
+# re-upload is cheaper than the scatter payload + index bookkeeping.
+_RESIDENT_DIFF_DIVISOR = 4
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _resident_scatter_cols(dev_big, idx, payload):
+    """Replace columns ``idx`` of the resident [3, E, M] buffer with
+    ``payload`` [3, E, k].  ``idx`` may repeat its last entry (bucketed
+    padding); duplicates carry identical column data, so the scatter is
+    deterministic.  Donation reuses the old buffer's HBM."""
+    return dev_big.at[:, :, idx].set(payload)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _resident_set_flows(dev_big, F):
+    """Fold a solve's flow result into resident plane 2 (device-side —
+    no transfer; the next warm round's init flows are already there)."""
+    return dev_big.at[2].set(F)
+
+
+def _resident_swap(big: np.ndarray) -> "jax.Array":
+    """Return a device handle for ``big``, uploading only what changed
+    since the last solve at this padded shape.  Falls back to a plain
+    full upload on first sight of a shape or wholesale change."""
+    key = big.shape[1:]
+    entry = _RESIDENT.pop(key, None)
+    if entry is None:
+        while len(_RESIDENT) >= _RESIDENT_MAX_SHAPES:
+            _RESIDENT.pop(next(iter(_RESIDENT)))  # LRU: oldest first
+        entry = {"host": big.copy(), "dev": jnp.asarray(big)}
+        _RESIDENT[key] = entry
+        return entry["dev"]
+    _RESIDENT[key] = entry  # re-insert: move-to-end keeps hot shapes
+    M_pad = key[1]
+    changed = np.nonzero((entry["host"] != big).any(axis=(0, 1)))[0]
+    k = len(changed)
+    if k == 0:
+        return entry["dev"]
+    if k > M_pad // _RESIDENT_DIFF_DIVISOR:
+        entry["host"] = big.copy()
+        entry["dev"] = jnp.asarray(big)
+        return entry["dev"]
+    # Bucket the index width (compile keys are per shape) and pad by
+    # repeating the last changed column — idempotent under .set.
+    k_pad = 1 << max(int(k - 1).bit_length(), 5)
+    k_pad = min(k_pad, M_pad)
+    idx = np.full(k_pad, changed[-1], dtype=np.int32)
+    idx[:k] = changed
+    payload = np.ascontiguousarray(big[:, :, idx])
+    entry["dev"] = _resident_scatter_cols(
+        entry["dev"], jnp.asarray(idx), jnp.asarray(payload)
+    )
+    entry["host"][:, :, changed] = big[:, :, changed]
+    return entry["dev"]
+
+
+def _resident_fold_result(key, F_dev, F_full: np.ndarray) -> None:
+    """After a flow-changing solve, keep the resident buffer's plane 2 in
+    sync with the result so the NEXT warm round's init flows diff clean."""
+    entry = _RESIDENT.get(key)
+    if entry is None:
+        return
+    entry["dev"] = _resident_set_flows(entry["dev"], F_dev)
+    entry["host"][2] = F_full
+
+
 # Platforms where device-side fixed costs (kernel launches, loop-step
 # syncs, per-dispatch tunnel round trips) dominate small-array work —
 # the backends the Pallas kernels and dispatch-count policies target.
@@ -1532,6 +1610,10 @@ def solve_transport(
             [max_iter_total, global_update_every, bf_max], dtype=np.int32
         ),
     ])
+    # Device-resident operand cache (accelerator backends): ship only
+    # the columns that changed since the last solve at this shape.
+    use_resident = accel_policy("POSEIDON_RESIDENT")
+    big_op = _resident_swap(big) if use_resident else big
 
     def _try_pallas(impl, latch_name):
         # A backend whose Mosaic lowering rejects a kernel must degrade
@@ -1541,8 +1623,8 @@ def solve_transport(
         # overflow at an alignment edge) says nothing about the others.
         try:
             return _solve_device_packed(
-                big, vec, max_iter=max_iter_per_phase, scale=int(scale),
-                impl=impl,
+                big_op, vec, max_iter=max_iter_per_phase,
+                scale=int(scale), impl=impl,
                 # Interpret mode on hosts without a Mosaic backend
                 # (tests / CPU with POSEIDON_FUSED/TILED=1); compiled on
                 # the accelerator.
@@ -1566,7 +1648,7 @@ def solve_transport(
         out = _try_pallas("tiled", "_TILED_BROKEN")
     if out is None:
         out = _solve_device_packed(
-            big, vec, max_iter=max_iter_per_phase, scale=int(scale),
+            big_op, vec, max_iter=max_iter_per_phase, scale=int(scale),
             impl="lax",
         )
     F_dev, small_dev = out
@@ -1585,7 +1667,12 @@ def solve_transport(
         # while flows_p is a view into this call's operand buffer.
         flows = flows_p[:E, :M].copy()
     else:
-        flows = np.asarray(F_dev)[:E, :M]
+        F_full = np.asarray(F_dev)
+        flows = F_full[:E, :M]
+        if use_resident:
+            # Fold the result into resident plane 2 so the next warm
+            # round's init flows diff clean (no re-upload).
+            _resident_fold_result((E_pad, M_pad), F_dev, F_full)
     prices_out = np.concatenate([
         prices_full[:E], prices_full[E_pad:E_pad + M],
         prices_full[E_pad + M_pad:],

@@ -665,3 +665,61 @@ def test_selective_precheck_skips_reduction_when_duals_certify(monkeypatch):
     assert sol.gap_bound == 0.0
     expected = oracle.transport_objective(costs, supply, cap, unsched)
     assert sol.objective == expected
+
+
+def test_resident_operand_cache_parity(monkeypatch):
+    """POSEIDON_RESIDENT=1: repeated solves at one padded shape ship
+    only changed columns onto a device-resident operand buffer and fold
+    flow results back into it.  Every round must stay oracle-exact
+    through all cache paths (cold upload, column scatter, wholesale
+    re-upload, warm-flows round with the fetch skip), and the resident
+    host mirror must track exactly what a fresh upload would ship."""
+    import poseidon_tpu.ops.transport as T
+
+    monkeypatch.setenv("POSEIDON_RESIDENT", "1")
+    T._RESIDENT.clear()
+    rng = np.random.default_rng(23)
+    E, M = 10, 120
+    costs = rng.integers(1, 400, size=(E, M)).astype(np.int32)
+    supply = rng.integers(0, 8, size=E).astype(np.int32)
+    cap = rng.integers(1, 6, size=M).astype(np.int32)
+    unsched = np.full(E, 900, dtype=np.int32)
+
+    sol = None
+    for rnd in range(5):
+        if rnd == 1:
+            costs[:, 7] += 3            # few-column drift -> scatter
+            costs[:, 40] -= 1
+        elif rnd == 2:
+            costs = rng.integers(       # wholesale change -> re-upload
+                1, 400, size=(E, M)
+            ).astype(np.int32)
+        # rnd 3: identical instance, warm flows -> zero-upload round
+        elif rnd == 4:
+            costs[3, :] += 5            # row drift touches many columns
+        warm = {} if sol is None else dict(
+            init_prices=sol.prices, init_flows=sol.flows,
+            init_unsched=sol.unsched, eps_start=8,
+        )
+        sol = T.solve_transport(costs, supply, cap, unsched, **warm)
+        assert sol.gap_bound == 0.0
+        expected = oracle.transport_objective(costs, supply, cap, unsched)
+        assert sol.objective == expected, rnd
+        # The resident mirror must equal what a fresh pack would ship.
+        (key, entry), = T._RESIDENT.items()
+        E_pad, M_pad = key
+        want = np.full((E_pad, M_pad), T.INF_COST, dtype=np.int32)
+        want[:E, :M] = costs
+        np.testing.assert_array_equal(entry["host"][0], want)
+        np.testing.assert_array_equal(
+            np.asarray(entry["dev"])[0], want
+        )
+        # Plane 2 tracks the last RESULT flows (fold-back), so the next
+        # warm round's init flows diff clean against it.
+        np.testing.assert_array_equal(
+            entry["host"][2][:E, :M], sol.flows
+        )
+        np.testing.assert_array_equal(
+            np.asarray(entry["dev"])[2], entry["host"][2]
+        )
+    T._RESIDENT.clear()
