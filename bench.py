@@ -428,6 +428,9 @@ def run_trace(machines: int, tasks: int, rounds: int) -> dict:
     from poseidon_tpu.replay.driver import ReplayDriver
     from poseidon_tpu.replay.trace import synthesize_trace
 
+    # Per-round stderr breadcrumbs: the round-5 TPU trace child spent
+    # its entire 3000 s budget with no observable output.
+    os.environ.setdefault("POSEIDON_REPLAY_PROGRESS", "1")
     events = synthesize_trace(
         machines, max(tasks // 8, 1), horizon_s=rounds * 10.0, seed=3
     )

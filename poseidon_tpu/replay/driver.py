@@ -11,6 +11,8 @@ cost objective — the driver for the 10k-node/100k-pod config 5.
 from __future__ import annotations
 
 import heapq
+import os
+import sys
 import time
 from dataclasses import dataclass, field
 from typing import List, Optional
@@ -186,11 +188,30 @@ class ReplayDriver:
                 shapes = self.planner.precompile(max_ecs=256)
                 report.precompile_s = time.perf_counter() - t0
                 report.precompile_shapes = shapes
+                if os.environ.get("POSEIDON_REPLAY_PROGRESS"):
+                    print(
+                        f"# replay precompile: {shapes} shapes in "
+                        f"{report.precompile_s:.1f}s",
+                        file=sys.stderr, flush=True,
+                    )
 
             deltas, metrics = self.planner.schedule_round()
             report.rounds += 1
             report.round_seconds.append(metrics.total_seconds)
             report.solve_seconds.append(metrics.solve_seconds)
+            if os.environ.get("POSEIDON_REPLAY_PROGRESS"):
+                # Per-round breadcrumbs for the bench harness: the
+                # round-5 TPU trace child burned its whole budget with
+                # zero observable output, leaving 'where did 3000 s go'
+                # unanswerable from the artifact.
+                print(
+                    f"# replay round {report.rounds}: "
+                    f"{metrics.total_seconds:.3f}s "
+                    f"solve={metrics.solve_seconds:.3f}s "
+                    f"placed={metrics.placed} pre={metrics.preempted} "
+                    f"mig={metrics.migrated}",
+                    file=sys.stderr, flush=True,
+                )
             report.placed += metrics.placed
             report.preempted += metrics.preempted
             report.migrated += metrics.migrated
