@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from dataclasses import dataclass
 from typing import Optional
 
@@ -615,6 +616,49 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
 # kernel for every shape it serves fine.
 _FUSED_BROKEN: set = set()
 _TILED_BROKEN: set = set()
+
+# Error-text markers of tunnel-side infrastructure failures (the axon
+# remote-compile service restarting, the tunnel dropping) as opposed to
+# real lowering/compile rejections.  Observed live during the round-5
+# 10k TPU run: 'UNAVAILABLE: http://127.0.0.1:8083/remote_compile:
+# ... Connection refused (os error 111)'.  Deliberately narrow: a real
+# Mosaic rejection routed through the remote-compile service must NOT
+# match (it carries INVALID_ARGUMENT/INTERNAL status text, not a
+# connection failure), and a watchdog DEADLINE on a runaway kernel is
+# real, not transient.
+_TRANSIENT_ERROR_MARKERS = (
+    "UNAVAILABLE", "Connection refused", "Connection reset",
+    "Connect error", "Socket closed",
+)
+
+
+def _is_transient_backend_error(e: BaseException) -> bool:
+    text = f"{type(e).__name__}: {e}"
+    return any(m in text for m in _TRANSIENT_ERROR_MARKERS)
+
+
+def _fetch_with_retry(dev_array, attempts: int = 3) -> np.ndarray:
+    """Device-to-host fetch riding out transient tunnel flakes.
+
+    Only used on arrays whose computation already completed (an earlier
+    fetch from the same dispatch succeeded), so a failure here is a pure
+    transfer problem and re-reading the live device buffer is sound.
+    """
+    for attempt in range(attempts):
+        try:
+            return np.asarray(dev_array)
+        except Exception as e:  # noqa: BLE001
+            if attempt == attempts - 1 or not _is_transient_backend_error(e):
+                raise
+            import logging
+
+            logging.getLogger("poseidon_tpu.transport").warning(
+                "transient error fetching a solve result (attempt "
+                "%d/%d): %s: %s; retrying", attempt + 1, attempts,
+                type(e).__name__, e,
+            )
+            time.sleep(5 * (attempt + 1))
+    raise AssertionError("unreachable")
 
 
 @functools.partial(
@@ -1621,8 +1665,13 @@ def solve_transport(
         # Once broken, stay off FOR THIS SHAPE: Pallas programs compile
         # per padded shape, so one shape's lowering failure (e.g. VMEM
         # overflow at an alignment edge) says nothing about the others.
+        # TRANSIENT failures (the tunnel's remote-compile service
+        # refusing connections — observed live at 10k: 'UNAVAILABLE:
+        # .../remote_compile: Connection refused') must NOT latch: they
+        # say nothing about Mosaic, and the latch would disable a
+        # working kernel for the process lifetime.
         try:
-            return _solve_device_packed(
+            F_d, small_d = _solve_device_packed(
                 big_op, vec, max_iter=max_iter_per_phase,
                 scale=int(scale), impl=impl,
                 # Interpret mode on hosts without a Mosaic backend
@@ -1630,14 +1679,20 @@ def solve_transport(
                 # the accelerator.
                 interpret=jax.default_backend() == "cpu",
             )
+            # Fetch INSIDE the guard: dispatch is async, so execution-
+            # time errors surface here, not at the call above.
+            return F_d, np.asarray(small_d)
         except Exception as e:  # noqa: BLE001 - availability over speed
-            globals()[latch_name].add((E_pad, M_pad))
             import logging
 
+            transient = _is_transient_backend_error(e)
+            if not transient:
+                globals()[latch_name].add((E_pad, M_pad))
             logging.getLogger("poseidon_tpu.transport").error(
                 "%s Pallas kernel unavailable for shape [%d, %d] on this "
-                "backend (%s: %s); using the lax path", impl,
+                "backend (%s: %s); using the lax path%s", impl,
                 E_pad, M_pad, type(e).__name__, e,
+                "" if transient else " (latched for this shape)",
             )
             return None
 
@@ -1646,13 +1701,32 @@ def solve_transport(
         out = _try_pallas("fused", "_FUSED_BROKEN")
     elif _use_tiled(E_pad, M_pad):
         out = _try_pallas("tiled", "_TILED_BROKEN")
-    if out is None:
-        out = _solve_device_packed(
-            big_op, vec, max_iter=max_iter_per_phase, scale=int(scale),
-            impl="lax",
-        )
-    F_dev, small_dev = out
-    small = np.asarray(small_dev)
+    for attempt in range(3):
+        if out is not None:
+            break
+        try:
+            F_d, small_d = _solve_device_packed(
+                big_op, vec, max_iter=max_iter_per_phase,
+                scale=int(scale), impl="lax",
+            )
+            # Fetch inside the retry: async dispatch surfaces
+            # execution/transfer errors at the first result read.
+            out = (F_d, np.asarray(small_d))
+        except Exception as e:  # noqa: BLE001
+            # The lax path has no fallback below it: ride out transient
+            # tunnel-side outages (remote-compile restarts) instead of
+            # killing the scheduler round; anything else is real.
+            if attempt == 2 or not _is_transient_backend_error(e):
+                raise
+            import logging
+
+            logging.getLogger("poseidon_tpu.transport").warning(
+                "transient backend error on solve [%d, %d] (attempt "
+                "%d/3): %s: %s; retrying in %ds", E_pad, M_pad,
+                attempt + 1, type(e).__name__, e, 10 * (attempt + 1),
+            )
+            time.sleep(10 * (attempt + 1))
+    F_dev, small = out
     o = E_pad
     unsched = small[:E]
     prices_full = small[o:o + E_pad + M_pad + 1]
@@ -1667,7 +1741,7 @@ def solve_transport(
         # while flows_p is a view into this call's operand buffer.
         flows = flows_p[:E, :M].copy()
     else:
-        F_full = np.asarray(F_dev)
+        F_full = _fetch_with_retry(F_dev)
         flows = F_full[:E, :M]
         if use_resident:
             # Fold the result into resident plane 2 so the next warm

@@ -376,12 +376,32 @@ def solve_transport_coarse_fused(
             dtype=np.int32,
         ),
     ])
-    F_dev, small_dev = _coarse_fused_device(
-        big, coarse3, vec,
-        groups=K, block=B, max_iter=max_iter_per_phase, scale=int(scale),
-    )
-    # One fetch decides the decline before the (large) flow fetch.
-    small = np.asarray(small_dev)
+    try:
+        F_dev, small_dev = _coarse_fused_device(
+            big, coarse3, vec,
+            groups=K, block=B, max_iter=max_iter_per_phase,
+            scale=int(scale),
+        )
+        # One fetch decides the decline before the (large) flow fetch —
+        # and it is the async sync point, so execution-time errors
+        # surface INSIDE this guard.
+        small = np.asarray(small_dev)
+    except Exception as e:  # noqa: BLE001
+        # A tunnel-side outage (remote-compile restart) must decline to
+        # the ordinary two-dispatch path, not kill the scheduler round;
+        # real errors propagate.
+        from poseidon_tpu.ops.transport import _is_transient_backend_error
+
+        if not _is_transient_backend_error(e):
+            raise
+        import logging
+
+        logging.getLogger("poseidon_tpu.transport").warning(
+            "transient backend error in the fused coarse dispatch "
+            "(%s: %s); declining to the two-dispatch path",
+            type(e).__name__, e,
+        )
+        return None
     o = e_pad + (e_pad + M2 + 1)
     iters, bf, clean, it_c, bf_c, clean_c, eps = (
         int(small[o]), int(small[o + 1]), bool(small[o + 2]),
@@ -391,7 +411,9 @@ def solve_transport_coarse_fused(
     phase_iters = small[o + 7:o + 7 + NUM_PHASES]
     if not clean_c:
         return None  # aggregated solve aborted: no usable lift
-    flows = np.asarray(F_dev)[:E, :M]
+    from poseidon_tpu.ops.transport import _fetch_with_retry
+
+    flows = _fetch_with_retry(F_dev)[:E, :M]
     unsched = small[:E]
     prices_full = small[e_pad:e_pad + e_pad + M2 + 1]
     prices_out = np.concatenate([

@@ -125,6 +125,10 @@ def test_fused_failure_degrades_to_lax(monkeypatch):
     monkeypatch.setenv("POSEIDON_FUSED", "1")
     monkeypatch.setattr(TF, "solve_device_fused", boom)
     monkeypatch.setattr(T, "_FUSED_BROKEN", set())
+    # The packed dispatch wrapper may hold a cached executable for this
+    # shape from earlier tests, which would bypass the monkeypatched
+    # kernel entirely (a cached trace never re-imports the module attr).
+    T._solve_device_packed.clear_cache()
     costs, supply, cap, unsched, arc = _instance(12, 64, 3)
     sol = solve_transport(costs, supply, cap, unsched, arc_capacity=arc)
     assert sol.gap_bound == 0.0

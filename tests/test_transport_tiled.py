@@ -36,7 +36,12 @@ def small_tiles(monkeypatch):
     monkeypatch.setattr(
         transport_tiled, "solve_device_tiled", counting
     )
+    # The packed dispatch wrapper caches executables per shape; a
+    # cached impl="tiled" entry from another test would bypass both the
+    # counting spy and the TILE_W/VMEM overrides above.
+    transport._solve_device_packed.clear_cache()
     yield calls
+    transport._solve_device_packed.clear_cache()
 
 
 def _instance(E, M, seed, contended=False):
