@@ -43,11 +43,13 @@ from poseidon_tpu.ops.transport import (
     _relabel_to,
 )
 
-# VMEM working-set gate: ~10 live [E, M] int32 arrays (C, Uem, F, rc, push
-# temporaries) plus slack must fit the ~16 MB/core budget.  4 bytes * 10 *
-# E*M <= ~12 MB  =>  E*M <= 300k; 2^18 = 262144 keeps headroom and makes
-# the bound a clean shape predicate ([256, 1024], [128, 2048], ...).
-VMEM_ELEM_BUDGET = 1 << 18
+# VMEM working-set gate, CALIBRATED ON LIVE v5e (2026-07-31 session):
+# [128, 2048] = 262144 elems hit "scoped allocation 20.71M, limit 16.00M"
+# at compile time => the kernel's peak working set is ~82.8 bytes/elem
+# (roughly 20 live [E, M] i32 arrays incl. compiler stack copies), so the
+# real ceiling is ~202k elems.  163840 ([128, 1280]) keeps ~17% headroom;
+# [128, 1024] = 131072 is proven good on hardware (1.74x over lax).
+VMEM_ELEM_BUDGET = 160 * 1024
 
 
 def fits_vmem(e_pad: int, m_pad: int) -> bool:

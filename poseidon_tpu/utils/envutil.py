@@ -83,18 +83,26 @@ def enable_compilation_cache(path: Optional[str] = None) -> None:
         return
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", path)
     # Cache even fast compiles: the dispatch-heavy round pipeline compiles
-    # many small shapes whose costs add up per process.  The env var makes
-    # the threshold hold for jax imported later in this process AND in
-    # child processes inheriting the environment.
+    # many small shapes whose costs add up per process.
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
-    if sys.modules.get("jax") is not None:
+    # This jax build does NOT read JAX_COMPILATION_CACHE_DIR from the
+    # environment (verified: config stays None, no cache files) — the
+    # config must be set explicitly.  jax.config.update does not
+    # initialize a backend, so importing here is safe pre-probe.
+    try:
+        min_secs = float(
+            os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"])
+    except ValueError:
+        min_secs = 0.2  # operator typo must not disable the cache
+    try:
         import jax
 
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update(
-            "jax_persistent_cache_min_compile_time_secs",
-            float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]),
-        )
+            "jax_persistent_cache_min_compile_time_secs", min_secs)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # noqa: BLE001 - the cache is an optimization only
+        return
 
 
 # ---------------------------------------------------------------- device lock

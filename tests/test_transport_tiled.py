@@ -11,7 +11,14 @@ import pytest
 
 from poseidon_tpu.ops import transport
 from poseidon_tpu.ops.transport import solve_transport
+from poseidon_tpu.ops import transport_fused
 from poseidon_tpu.ops import transport_tiled
+
+# Production constants captured at import time, BEFORE the autouse
+# fixture shrinks them — the gate test below must exercise the real
+# fused/tiled routing boundary, not a stale hardcoded copy.
+PROD_VMEM_BUDGET = transport_fused.VMEM_ELEM_BUDGET
+PROD_TILE_W = transport_tiled.TILE_W
 
 
 @pytest.fixture(autouse=True)
@@ -127,12 +134,12 @@ def test_tiled_bit_parity_warm_start(monkeypatch, small_tiles):
 
 
 def test_use_tiled_gate(monkeypatch):
-    from poseidon_tpu.ops import transport_fused
-
     # The autouse fixture shrinks the VMEM budget / tile width for the
-    # parity tests; the gate semantics are defined against production.
-    monkeypatch.setattr(transport_fused, "VMEM_ELEM_BUDGET", 1 << 18)
-    monkeypatch.setattr(transport_tiled, "TILE_W", 512)
+    # parity tests; the gate semantics are defined against production —
+    # restore the import-time constants rather than hardcoding copies.
+    monkeypatch.setattr(transport_fused, "VMEM_ELEM_BUDGET",
+                        PROD_VMEM_BUDGET)
+    monkeypatch.setattr(transport_tiled, "TILE_W", PROD_TILE_W)
     monkeypatch.delenv("POSEIDON_TILED", raising=False)
     monkeypatch.setattr(transport, "_TILED_BROKEN", set())
     # CPU backend: off by default.
@@ -141,6 +148,9 @@ def test_use_tiled_gate(monkeypatch):
     assert transport._use_tiled(256, 10240)
     # VMEM-sized instances belong to the fused kernel, not this one.
     assert not transport._use_tiled(128, 1024)
+    # Shapes in the 160k-262k elem gap moved tiers when the live v5e
+    # OOM calibrated the budget down: they are tiled-tier now.
+    assert transport._use_tiled(128, 2048)
     # Row-bound: a column tile's working set must fit.
     assert not transport._use_tiled(1024, 10240)
     # The broken latch wins over the force flag.
