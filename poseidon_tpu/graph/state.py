@@ -536,23 +536,29 @@ class ClusterState:
         applied = False
         native_uids = []
         native_keys = []
+        # Hot loop (100k tasks on the initial wave): bind attribute
+        # lookups outside it.
+        tasks_get = self.tasks.get
+        has_native = self._native is not None
+        nkey = self._nkey
+        uids_append = native_uids.append
+        keys_append = native_keys.append
+        runnable, running = TaskState.RUNNABLE, TaskState.RUNNING
         with self._lock:
             for uid, machine_uuid in placements:
-                task = self.tasks.get(uid)
+                task = tasks_get(uid)
                 if task is None:
                     continue
                 task.scheduled_to = machine_uuid
                 if machine_uuid is None:
-                    task.state = TaskState.RUNNABLE
+                    task.state = runnable
                     task.wait_rounds += 1
                 else:
-                    task.state = TaskState.RUNNING
+                    task.state = running
                     task.wait_rounds = 0
-                if self._native is not None:
-                    native_uids.append(uid)
-                    native_keys.append(
-                        self._nkey(machine_uuid) if machine_uuid else 0
-                    )
+                if has_native:
+                    uids_append(uid)
+                    keys_append(nkey(machine_uuid) if machine_uuid else 0)
                 applied = True
             if native_uids:
                 # One C call for the whole round: a ctypes call per task

@@ -54,6 +54,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from poseidon_tpu.utils.stagetimer import stage as _stage
+
 # Raw (cost-model) costs must fit in COST_CAP; admissibility masking uses
 # INF_COST.  Working costs are raw * SCALE.
 COST_CAP = 1 << 14
@@ -1612,15 +1614,17 @@ def solve_transport(
         arc_capacity = np.asarray(arc_capacity, dtype=np.int32)
         if (arc_capacity < 0).any():
             raise ValueError("arc_capacity must be non-negative")
-    init_flows, init_unsched, init_prices, eps_start = maybe_greedy_start(
-        greedy_init, init_flows, init_prices, init_unsched, eps_start,
-        costs, supply, capacity, arc_capacity, unsched_cost,
-        max_cost_hint, E_pad, M_pad, scale=scale,
-    )
-    scale, eps_sched = _host_validate(
-        costs_p, supply_p, capacity_p, unsched_p, scale, eps_start,
-        max_cost_hint,
-    )
+    with _stage("solve.greedy_start"):
+        init_flows, init_unsched, init_prices, eps_start = maybe_greedy_start(
+            greedy_init, init_flows, init_prices, init_unsched, eps_start,
+            costs, supply, capacity, arc_capacity, unsched_cost,
+            max_cost_hint, E_pad, M_pad, scale=scale,
+        )
+    with _stage("solve.validate"):
+        scale, eps_sched = _host_validate(
+            costs_p, supply_p, capacity_p, unsched_p, scale, eps_start,
+            max_cost_hint,
+        )
     prices_p = np.zeros(E_pad + M_pad + 1, dtype=np.int32)
     if init_prices is not None:
         # Normalized warm prices are <= 0 with max 0, so the zero-filled
@@ -1657,7 +1661,8 @@ def solve_transport(
     # Device-resident operand cache (accelerator backends): ship only
     # the columns that changed since the last solve at this shape.
     use_resident = accel_policy("POSEIDON_RESIDENT")
-    big_op = _resident_swap(big) if use_resident else big
+    with _stage("solve.upload"):
+        big_op = _resident_swap(big) if use_resident else big
 
     def _try_pallas(impl, latch_name):
         # A backend whose Mosaic lowering rejects a kernel must degrade
@@ -1671,17 +1676,19 @@ def solve_transport(
         # say nothing about Mosaic, and the latch would disable a
         # working kernel for the process lifetime.
         try:
-            F_d, small_d = _solve_device_packed(
-                big_op, vec, max_iter=max_iter_per_phase,
-                scale=int(scale), impl=impl,
-                # Interpret mode on hosts without a Mosaic backend
-                # (tests / CPU with POSEIDON_FUSED/TILED=1); compiled on
-                # the accelerator.
-                interpret=jax.default_backend() == "cpu",
-            )
-            # Fetch INSIDE the guard: dispatch is async, so execution-
-            # time errors surface here, not at the call above.
-            return F_d, np.asarray(small_d)
+            with _stage("solve.device_wait"):
+                F_d, small_d = _solve_device_packed(
+                    big_op, vec, max_iter=max_iter_per_phase,
+                    scale=int(scale), impl=impl,
+                    # Interpret mode on hosts without a Mosaic backend
+                    # (tests / CPU with POSEIDON_FUSED/TILED=1); compiled
+                    # on the accelerator.
+                    interpret=jax.default_backend() == "cpu",
+                )
+                # Fetch INSIDE the guard: dispatch is async, so execution-
+                # time errors surface here, not at the call above.
+                small_h = np.asarray(small_d)
+            return F_d, small_h
         except Exception as e:  # noqa: BLE001 - availability over speed
             import logging
 
@@ -1705,13 +1712,14 @@ def solve_transport(
         if out is not None:
             break
         try:
-            F_d, small_d = _solve_device_packed(
-                big_op, vec, max_iter=max_iter_per_phase,
-                scale=int(scale), impl="lax",
-            )
-            # Fetch inside the retry: async dispatch surfaces
-            # execution/transfer errors at the first result read.
-            out = (F_d, np.asarray(small_d))
+            with _stage("solve.device_wait"):
+                F_d, small_d = _solve_device_packed(
+                    big_op, vec, max_iter=max_iter_per_phase,
+                    scale=int(scale), impl="lax",
+                )
+                # Fetch inside the retry: async dispatch surfaces
+                # execution/transfer errors at the first result read.
+                out = (F_d, np.asarray(small_d))
         except Exception as e:  # noqa: BLE001
             # The lax path has no fallback below it: ride out transient
             # tunnel-side outages (remote-compile restarts) instead of
@@ -1741,7 +1749,8 @@ def solve_transport(
         # while flows_p is a view into this call's operand buffer.
         flows = flows_p[:E, :M].copy()
     else:
-        F_full = _fetch_with_retry(F_dev)
+        with _stage("solve.fetch_flows"):
+            F_full = _fetch_with_retry(F_dev)
         flows = F_full[:E, :M]
         if use_resident:
             # Fold the result into resident plane 2 so the next warm

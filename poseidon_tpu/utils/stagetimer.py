@@ -1,0 +1,61 @@
+"""Per-stage wall timers for the schedule round, enabled by
+``POSEIDON_STAGE_TIMERS=1`` (zero overhead otherwise: the context
+manager short-circuits).
+
+Why: the tunneled accelerator's wave budget splits between host prep
+(cost build, greedy starts, epsilon derivation), per-transfer tunnel
+latency (~60-150 ms per direction, measured 2026-07-31 live session),
+in-program device time, and host assignment/commit — and the winning
+optimization differs for each.  ``tools/profile_wave.py`` reads the
+accumulated table after driving waves against the real backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+from typing import Dict, Tuple
+
+_totals: Dict[str, float] = defaultdict(float)
+_counts: Dict[str, int] = defaultdict(int)
+
+
+def enabled() -> bool:
+    return os.environ.get("POSEIDON_STAGE_TIMERS") == "1"
+
+
+@contextlib.contextmanager
+def stage(name: str):
+    if not enabled():
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        _totals[name] += dt
+        _counts[name] += 1
+
+
+def snapshot() -> Dict[str, Tuple[float, int]]:
+    """{stage: (total_seconds, calls)} accumulated since last reset."""
+    return {k: (_totals[k], _counts[k]) for k in _totals}
+
+
+def reset() -> None:
+    _totals.clear()
+    _counts.clear()
+
+
+def report() -> str:
+    rows = sorted(snapshot().items(), key=lambda kv: -kv[1][0])
+    width = max((len(k) for k, _ in rows), default=4)
+    lines = [f"{'stage'.ljust(width)}  total_s   calls  per_call_ms"]
+    for k, (tot, n) in rows:
+        lines.append(
+            f"{k.ljust(width)}  {tot:7.3f}  {n:6d}  {1000 * tot / max(n, 1):10.2f}"
+        )
+    return "\n".join(lines)

@@ -1,0 +1,104 @@
+"""Per-stage decomposition of a full schedule round at scale, on the
+live backend: host prep vs tunnel transfers vs in-program device time
+vs assignment/commit.  This is the measurement that picks between the
+wave's two remaining levers (single-dispatch band fusion vs host-path
+cuts) — run it on the real TPU before touching either.
+
+Usage (serialize against other chip users; never external-kill):
+    python tools/profile_wave.py [--machines 10000] [--tasks 100000]
+                                 [--waves 4] [--churn 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--machines", type=int, default=10000)
+    ap.add_argument("--tasks", type=int, default=100000)
+    ap.add_argument("--ecs", type=int, default=100)
+    ap.add_argument("--waves", type=int, default=4)
+    ap.add_argument("--churn", type=int, default=3)
+    args = ap.parse_args()
+
+    os.environ["POSEIDON_STAGE_TIMERS"] = "1"
+
+    from poseidon_tpu.utils.envutil import (
+        enable_compilation_cache,
+        probe_device_count,
+        serialize_device_access,
+    )
+
+    if not serialize_device_access():
+        print("device lock busy; aborting", flush=True)
+        raise SystemExit(2)
+    if probe_device_count(timeout=300.0) < 0:
+        print("backend unreachable; aborting", flush=True)
+        raise SystemExit(2)
+    enable_compilation_cache()
+
+    import jax
+
+    from bench import build_cluster, submit_population
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+    from poseidon_tpu.utils import stagetimer
+
+    print(f"backend: {jax.devices()[0].platform}", flush=True)
+    M, T, E = args.machines, args.tasks, args.ecs
+    state = build_cluster(M, T, E, seed=0)
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+
+    t0 = time.perf_counter()
+    planner.schedule_round()
+    print(f"cold: {time.perf_counter() - t0:.2f}s", flush=True)
+    t0 = time.perf_counter()
+    shapes = planner.precompile(max_ecs=256)
+    print(f"precompile: {shapes} shapes {time.perf_counter() - t0:.1f}s",
+          flush=True)
+
+    stagetimer.reset()
+    wave_lat = []
+    for r in range(args.waves):
+        for uid in list(state.tasks.keys()):
+            state.task_removed(uid)
+        submit_population(state, T, E, seed=r + 1)
+        t0 = time.perf_counter()
+        _, m = planner.schedule_round()
+        dt = time.perf_counter() - t0
+        wave_lat.append(dt)
+        print(f"wave {r}: {dt:.3f}s solve={m.solve_seconds:.3f}s "
+              f"iters={m.iterations} calls={m.device_calls}", flush=True)
+    print(f"\n== WAVE stage table ({args.waves} waves, p50 wall "
+          f"{float(np.percentile(wave_lat, 50)):.3f}s) ==")
+    print(stagetimer.report(), flush=True)
+
+    stagetimer.reset()
+    rng = np.random.default_rng(99)
+    churn_lat = []
+    for r in range(args.churn):
+        uids = list(state.tasks.keys())
+        for uid in rng.choice(len(uids), size=max(T // 100, 1),
+                              replace=False):
+            state.task_removed(uids[int(uid)])
+        submit_population(state, max(T // 100, 1), E, seed=1000 + r)
+        t0 = time.perf_counter()
+        planner.schedule_round()
+        churn_lat.append(time.perf_counter() - t0)
+        print(f"churn {r}: {churn_lat[-1]:.3f}s", flush=True)
+    print(f"\n== CHURN stage table ({args.churn} rounds, p50 wall "
+          f"{float(np.percentile(churn_lat, 50)):.3f}s) ==")
+    print(stagetimer.report(), flush=True)
+
+
+if __name__ == "__main__":
+    main()
