@@ -134,10 +134,17 @@ class _Telemetry:
     including solves hidden inside the selective wrapper's fallback."""
 
     device_calls = 0
+    # Solves answered entirely by the host certificate (no dispatch):
+    # the warm/greedy start proved exactly optimal pre-dispatch.
+    host_cert_returns = 0
 
 
 def device_call_count() -> int:
     return _Telemetry.device_calls
+
+
+def host_cert_count() -> int:
+    return _Telemetry.host_cert_returns
 
 
 @dataclass
@@ -1614,6 +1621,7 @@ def solve_transport(
         arc_capacity = np.asarray(arc_capacity, dtype=np.int32)
         if (arc_capacity < 0).any():
             raise ValueError("arc_capacity must be non-negative")
+    was_warm = init_flows is not None or init_prices is not None
     with _stage("solve.greedy_start"):
         init_flows, init_unsched, init_prices, eps_start = maybe_greedy_start(
             greedy_init, init_flows, init_prices, init_unsched, eps_start,
@@ -1647,6 +1655,68 @@ def solve_transport(
     fb_p = np.zeros(E_pad, dtype=np.int32)
     if init_unsched is not None:
         fb_p[:E] = init_unsched
+
+    # Host short-circuit: when the start state (remapped warm frame or
+    # the greedy cold start) is already feasible AND certifies EXACTLY
+    # (eps_actual <= 1 — the same _certified_eps the device path's
+    # finalize uses for gap_bound == 0), the device would return it
+    # bit-for-bit with iters=0.  Measured live at 10k/100k (2026-07-31):
+    # every steady churn and restart round is such a round, and each
+    # paid ~0.5 s of tunnel round trips for a no-op dispatch.  The check
+    # is one O(E*M) host pass (~40 ms at full 10k width, less at
+    # selective widths) and _host_finalize already implements it: any
+    # repair it performs flips converged False, so gap_bound == 0.0
+    # certifies both feasibility and exactness.  Misses cost the pass
+    # and proceed to the dispatch unchanged — bit-identical results
+    # either way, on every backend, sharded or not.
+    # Cold rounds only attempt it when the greedy start's own exact
+    # certificate (eps_start == geps from maybe_greedy_start) already
+    # proves it would pass — the fresh-wave common case (contended,
+    # geps >> 1) then pays nothing.  Warm frames always attempt: their
+    # eps_start is a drift BOUND, not the start's certificate, and the
+    # live-TPU churn rounds this exists for all came in warm.
+    if (
+        init_flows is not None
+        and init_unsched is not None
+        and init_prices is not None
+        and (was_warm or (eps_start is not None and eps_start <= 1))
+        and os.environ.get("POSEIDON_HOST_CERT", "1") != "0"
+    ):
+        with _stage("solve.host_cert"):
+            # Flow stranded on an arc the CURRENT costs forbid (gang
+            # repair re-solves with freshly INF'd rows; selector churn
+            # can do the same) is invisible to the epsilon certificate
+            # (inadmissible arcs are excluded from reduced-cost checks)
+            # but the device WOULD push it off — never skip then.
+            # Same blindness applies to a TIGHTENED finite arc bound:
+            # the device clamps the start to Uem and re-places the
+            # excess; the epsilon certificate's forward mask just
+            # skips saturated arcs.  Dispatch whenever the start
+            # exceeds either admissibility form.
+            on_forbidden = bool(
+                init_flows[costs >= INF_COST].any()
+            ) or (
+                arc_capacity is not None
+                and bool((init_flows > arc_capacity).any())
+            )
+            cand = None
+            if not on_forbidden:
+                cand = _host_finalize(
+                    init_flows, init_unsched, init_prices, 0,
+                    costs=costs, supply=supply, capacity=capacity,
+                    unsched_cost=unsched_cost, scale=scale, clean=True,
+                    arc_capacity=arc_capacity,
+                )
+        if cand is not None and cand.gap_bound == 0.0:
+            _Telemetry.host_cert_returns += 1
+            # Callers own their return value; without a repair the
+            # finalize hands back the warm frame's own arrays (the
+            # packed path's unchanged-case copies for the same reason).
+            return TransportSolution(
+                flows=cand.flows.copy(), unsched=cand.unsched.copy(),
+                prices=cand.prices, objective=cand.objective,
+                gap_bound=0.0, iterations=0,
+            )
 
     if max_iter_total is None:
         max_iter_total = NUM_PHASES * max_iter_per_phase

@@ -391,6 +391,10 @@ class TestBandMerging:
         self, monkeypatch
     ):
         monkeypatch.setenv("POSEIDON_MERGE_BANDS", "1")
+        # Dispatch-structure test: the host certificate would answer
+        # these slack-heavy instances with zero dispatches on BOTH
+        # sides, erasing the count contrast under test.
+        monkeypatch.setenv("POSEIDON_HOST_CERT", "0")
         # Plenty of slack (640 big-task units of CPU vs 220 tasks):
         # big and small bands merge into one dispatch.
         st1 = self._mixed_state(40, 32, 20, 200, cpu_cap=64000)
@@ -449,6 +453,7 @@ class TestBandMerging:
         """On CPU (dispatches ~free) merging is off by default: the
         measured trade reverses at 10k scale (see _next_band_group)."""
         monkeypatch.delenv("POSEIDON_MERGE_BANDS", raising=False)
+        monkeypatch.setenv("POSEIDON_HOST_CERT", "0")  # count the bands
         st = self._mixed_state(40, 32, 20, 200, cpu_cap=64000)
         planner = RoundPlanner(st, CpuMemCostModel())
         _, m = planner.schedule_round()

@@ -57,12 +57,17 @@ def test_quiet_round_fast_path():
         TaskInfo(uid=task_uid("ijob", 999), job_id="ijob-x",
                  cpu_request=100, ram_request=1 << 18)
     )
+    from poseidon_tpu.ops.transport import host_cert_count
+
+    cert0 = host_cert_count()
     deltas3, m3 = planner.schedule_round()
-    # device_calls, not iterations: the greedy+auction-dual cold start
-    # can solve a one-task instance in ZERO device iterations (already
-    # optimal at entry) — the dispatch count is what proves the solve
-    # re-armed.
-    assert m3.device_calls > 0 and m3.placed == 1
+    # The greedy+auction-dual cold start can solve a one-task instance
+    # in ZERO device iterations — and the host certificate may then
+    # answer it without any dispatch at all.  The solve re-arming is
+    # proven by a dispatch OR a host-certified return, plus the
+    # placement itself.
+    assert (m3.device_calls > 0 or host_cert_count() > cert0)
+    assert m3.placed == 1
     # The re-solve may migrate toward a cheaper optimum; it must then
     # settle: the following round is quiet again.
     deltas4, m4 = planner.schedule_round()

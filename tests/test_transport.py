@@ -677,6 +677,10 @@ def test_resident_operand_cache_parity(monkeypatch):
     import poseidon_tpu.ops.transport as T
 
     monkeypatch.setenv("POSEIDON_RESIDENT", "1")
+    # Cache-path test: warm rounds here certify exactly, and the host
+    # certificate would answer them without ever touching the resident
+    # buffer — force every round through the dispatch paths under test.
+    monkeypatch.setenv("POSEIDON_HOST_CERT", "0")
     T._RESIDENT.clear()
     rng = np.random.default_rng(23)
     E, M = 10, 120
@@ -723,3 +727,71 @@ def test_resident_operand_cache_parity(monkeypatch):
             np.asarray(entry["dev"])[2], entry["host"][2]
         )
     T._RESIDENT.clear()
+
+
+def test_host_cert_skips_dispatch_bit_identical(monkeypatch):
+    """A warm re-solve of an unchanged instance must be answered by the
+    host certificate with ZERO device dispatches, and the answer must be
+    bit-identical to what the dispatch path returns (the device would
+    run 0 iterations and hand the start back unchanged).  Measured
+    motivation: every live-TPU churn/restart round at 10k/100k was such
+    a round paying ~0.5 s of tunnel transfers for a no-op dispatch."""
+    import poseidon_tpu.ops.transport as T
+
+    rng = np.random.default_rng(77)
+    costs, supply, cap, unsched = random_instance(rng, 8, 12)
+    sol1 = solve_transport(costs, supply, cap, unsched)
+    warm = dict(init_prices=sol1.prices, init_flows=sol1.flows,
+                init_unsched=sol1.unsched, eps_start=1)
+
+    calls0, cert0 = T.device_call_count(), T.host_cert_count()
+    sol2 = solve_transport(costs, supply, cap, unsched, **warm)
+    assert T.host_cert_count() == cert0 + 1
+    assert T.device_call_count() == calls0  # no dispatch
+    assert sol2.gap_bound == 0.0 and sol2.iterations == 0
+
+    # Force the dispatch path on the identical warm instance: the
+    # short-circuit must be invisible in every returned field.
+    monkeypatch.setenv("POSEIDON_HOST_CERT", "0")
+    sol3 = solve_transport(costs, supply, cap, unsched, **warm)
+    assert sol3.objective == sol2.objective == sol1.objective
+    np.testing.assert_array_equal(sol2.flows, sol3.flows)
+    np.testing.assert_array_equal(sol2.unsched, sol3.unsched)
+    np.testing.assert_array_equal(sol2.prices, sol3.prices)
+
+    # Caller ownership: mutating the returned arrays must not corrupt
+    # the warm frame handed in.
+    sol2.flows[0, 0] += 1
+    assert not np.array_equal(sol2.flows, sol1.flows)
+
+
+def test_host_cert_respects_tightened_arc_capacity():
+    """A warm frame whose flows exceed a freshly TIGHTENED finite arc
+    bound must DISPATCH (the device clamps the start to Uem and
+    re-places the excess); the epsilon certificate's forward mask skips
+    saturated arcs, so without the guard the host path would return an
+    arc-infeasible placement as certified-optimal."""
+    import poseidon_tpu.ops.transport as T
+
+    costs = np.array([[1, 50]], dtype=np.int32)
+    supply = np.array([5], dtype=np.int32)
+    cap = np.array([8, 8], dtype=np.int32)
+    unsched = np.array([500], dtype=np.int32)
+    wide = np.array([[5, 5]], dtype=np.int32)
+    sol1 = solve_transport(costs, supply, cap, unsched, arc_capacity=wide)
+    assert sol1.flows[0, 0] == 5  # all on the cheap arc
+
+    tight = np.array([[3, 5]], dtype=np.int32)  # cheap arc tightened
+    cert0 = T.host_cert_count()
+    sol2 = solve_transport(
+        costs, supply, cap, unsched, arc_capacity=tight,
+        init_prices=sol1.prices, init_flows=sol1.flows,
+        init_unsched=sol1.unsched, eps_start=1,
+    )
+    assert T.host_cert_count() == cert0  # guard forced the dispatch
+    assert (sol2.flows <= tight).all()
+    assert sol2.flows[0, 0] == 3 and sol2.flows[0, 1] == 2
+    expected = oracle.transport_objective(
+        costs, supply, cap, unsched, arc_capacity=tight
+    )
+    assert sol2.objective == expected

@@ -29,8 +29,10 @@ def small_tiles(monkeypatch):
     # without this the gate routes them to the lax/fused tiers and the
     # parity assertions are vacuous).
     monkeypatch.setattr(transport_tiled, "TILE_W", 128)
-    from poseidon_tpu.ops import transport_fused
-
+    # Kernel-parity tests: a trivially-certifiable instance (e.g. the
+    # all-inadmissible case) would be answered by the host certificate
+    # before the kernel ever runs — force the dispatch path.
+    monkeypatch.setenv("POSEIDON_HOST_CERT", "0")
     monkeypatch.setattr(transport_fused, "VMEM_ELEM_BUDGET", 1024)
     # Prove the kernel actually ran on the POSEIDON_TILED=1 leg.
     calls = {"n": 0}
