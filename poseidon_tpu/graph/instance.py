@@ -184,6 +184,25 @@ def _slice_ecs(ecs, idx: np.ndarray):
     )
 
 
+_ASSIGN_POOL = None
+
+
+def _shared_assign_pool():
+    """One process-wide single-worker pool for assignment pipelining.
+
+    A single worker keeps chunk execution strictly serialized (the
+    pipelining contract: overlap with the DEVICE, never with another
+    chunk); concurrent.futures joins it at interpreter exit."""
+    global _ASSIGN_POOL
+    if _ASSIGN_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _ASSIGN_POOL = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="poseidon-assign"
+        )
+    return _ASSIGN_POOL
+
+
 def _with_usage(mt, cpu_used, ram_used, net_used, slots_free):
     """MachineTable with this band's committed-resource view.
 
@@ -569,7 +588,51 @@ class RoundPlanner:
         from poseidon_tpu.ops.transport import device_call_count
 
         calls0 = device_call_count()
-        flows = self._solve_banded(ecs, mt, metrics)
+        # Assignment pipelining: a finished band's EC->task assignment
+        # (pure host work, ~0.5 s of a 10k fresh wave) runs on a worker
+        # thread WHILE the next band's solve occupies the device — the
+        # main thread spends that window blocked in tunnel transfers /
+        # XLA compute with the GIL released.  The LAST band's chunk is
+        # deferred to the assign phase below (keeping solve_seconds an
+        # honest solver-only number), after a join, so chunks never run
+        # concurrently.  Chunks merge in band order: deterministic and
+        # identical to the POSEIDON_OVERLAP_ASSIGN=0 path (note: band
+        # order, not global EC order — cross-EC delta order within a
+        # round is not contractual).
+        chunks: dict = {}
+        futures: list = []
+        deferred: list = []
+        pool = None
+        if os.environ.get("POSEIDON_OVERLAP_ASSIGN", "1") != "0":
+            pool = _shared_assign_pool()
+
+        def on_band(idx, is_last, flows_full):
+            order = len(chunks)
+            chunks[order] = None
+
+            def work():
+                chunks[order] = self._assign_ecs(
+                    idx.tolist(), flows_full, view, metrics
+                )
+
+            if pool is not None and not is_last:
+                futures.append(pool.submit(work))
+            else:
+                deferred.append(work)
+
+        try:
+            flows = self._solve_banded(ecs, mt, metrics, on_band=on_band)
+        except BaseException:
+            # A failed solve must not leave an orphaned worker chunk
+            # mutating shared state (prior_machine hints) for a round
+            # that never commits — join before propagating; chunk
+            # errors are secondary to the solve failure.
+            for f in futures:
+                try:
+                    f.result()
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
         # Counter delta, not dispatch-wrapper invocations: the selective
         # wrapper's full-solve fallback is two real device round trips,
         # and the host ssp path is zero.
@@ -588,7 +651,24 @@ class RoundPlanner:
             )
 
         with _stage("round.assign"):
-            deltas = self._assign(flows, view, metrics)
+            if chunks:
+                # Join the workers, run the deferred last chunk, merge
+                # in band order, commit once — identical deltas and
+                # placements to the non-pipelined chunked path.
+                for f in futures:
+                    f.result()
+                for work in deferred:
+                    work()
+                deltas = []
+                placements: list = []
+                for k in sorted(chunks):
+                    d, p = chunks[k]
+                    deltas.extend(d)
+                    placements.extend(p)
+                st.apply_placements(placements)
+            else:
+                # Degenerate paths that skipped every band (M == 0).
+                deltas = self._assign(flows, view, metrics)
         st.round_index += 1
         self._last_generation = st.generation
         # Any task left off a machine — still waiting OR freshly preempted —
@@ -778,7 +858,7 @@ class RoundPlanner:
             n += 1
         return n, np.sort(idx)
 
-    def _solve_banded(self, ecs, mt, metrics) -> np.ndarray:
+    def _solve_banded(self, ecs, mt, metrics, on_band=None) -> np.ndarray:
         """The round's solve: size-banded transportation with committed
         resources flowing between bands.
 
@@ -891,6 +971,12 @@ class RoundPlanner:
             committed_ram += fl.T @ ecs_b.ram_request.astype(np.int64)
             committed_net += fl.T @ net_req.astype(np.int64)
             committed_slots += fl.sum(axis=0)
+            if on_band is not None:
+                # Hand this band's rows to the caller (assignment
+                # pipelining) the moment its flows are final.  Later
+                # bands write DISJOINT rows of flows_full, so a worker
+                # reading this band's rows races nothing.
+                on_band(idx, not remaining, flows_full)
 
         metrics.objective = objective
         metrics.gap_bound = gap
@@ -1182,6 +1268,27 @@ class RoundPlanner:
            (bounded unfairness), machine columns in ascending order;
         3. diffs against the previous placement become the deltas.
         """
+        deltas, placements = self._assign_ecs(
+            range(view.ecs.num_ecs), flows, view, metrics
+        )
+        self.state.apply_placements(placements)
+        return deltas
+
+    def _assign_ecs(
+        self,
+        ec_indices,
+        flows: np.ndarray,
+        view,
+        metrics: RoundMetrics,
+    ) -> Tuple[List[Delta], List[Tuple[int, Optional[str]]]]:
+        """The per-EC assignment loop over a SUBSET of EC rows.
+
+        Factored out of ``_assign`` so a band's assignment can run on a
+        worker thread while the next band's solve occupies the device
+        (the main thread blocks in tunnel fetches / XLA compute with the
+        GIL released).  Does NOT touch ClusterState placements — callers
+        merge the returned chunks in band order and apply once, keeping
+        delta order deterministic regardless of thread timing."""
         deltas: List[Delta] = []
         st = self.state
         mt = view.machines
@@ -1189,7 +1296,7 @@ class RoundPlanner:
         uuids = mt.uuids
         placements: List[Tuple[int, Optional[str]]] = []
 
-        for i in range(view.ecs.num_ecs):
+        for i in ec_indices:
             uids = view.member_uids[i]
             cur = view.member_cur[i]
             wait = view.member_wait[i]
@@ -1302,5 +1409,4 @@ class RoundPlanner:
             still = np.nonzero((new_col < 0) & (cur < 0))[0]
             placements.extend((u, None) for u in uids[still].tolist())
 
-        st.apply_placements(placements)
-        return deltas
+        return deltas, placements

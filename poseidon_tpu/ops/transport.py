@@ -1811,6 +1811,18 @@ def solve_transport(
     o += E_pad + M_pad + 1
     iters, bf, clean, unchanged = (int(small[o]), int(small[o + 1]),
                                    bool(small[o + 2]), bool(small[o + 3]))
+    if not unchanged:
+        # Start the flow-matrix transfer NOW, concurrently with the
+        # decode/finalize work below: on the tunneled accelerator each
+        # fetch pays a ~60-150 ms latency slot, and serializing it
+        # behind the host-side bookkeeping put that slot on the
+        # critical path of every changed round.  Gated on the
+        # unchanged bit (already host-resident in `small`) so warm
+        # no-op rounds keep their zero-transfer fetch skip.
+        try:
+            F_dev.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass  # backends without async copy: fetch plain below
     phase_iters = small[o + 4:o + 4 + NUM_PHASES]
     if unchanged:
         # The solve returned the warm start bit-for-bit; reuse the
