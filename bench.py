@@ -104,6 +104,66 @@ def _prework_allowance() -> int:
                ) + 300
 
 
+def _probe_matmul() -> bool:
+    """One end-to-end backend check in a disposable subprocess.
+
+    A matmul, not jax.devices(): eager ops COMPILE, so this verifies the
+    whole chain — tunnel, device, and the remote-compile service.  The
+    observed mid-ladder failure mode (2026-07-31) was a live tunnel
+    whose compile service died: device listing succeeds, every child
+    then crashes on its first fresh compile.  No compile cache is
+    enabled in the probe, so a cached executable can't mask a dead
+    service."""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax,jax.numpy as jnp;"
+             "print(float((jnp.ones((64,64))@jnp.ones((64,64))).sum()))"],
+            capture_output=True, text=True, timeout=300,
+        )
+        # ones(64,64) @ ones(64,64) sums to 64**3 = 262144.
+        return probe.returncode == 0 and "262144" in probe.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _latch_cpu_env() -> None:
+    """Rewrite this process's environment to the clean-CPU one (children
+    inherit it) and release the device flock — the bench will not touch
+    the chip again."""
+    from poseidon_tpu.utils.envutil import clean_cpu_env, release_device_lock
+
+    env = clean_cpu_env(os.path.dirname(os.path.abspath(__file__)))
+    env["POSEIDON_BENCH_NO_PROBE"] = "1"
+    os.environ.clear()
+    os.environ.update(env)
+    release_device_lock()
+
+
+def _stage_failed_recheck(res: dict) -> bool:
+    """After a FAILED stage in accelerator mode, re-verify the backend.
+
+    The tunnel's compile service has died mid-ladder in both live
+    sessions (period ~30 min); with the verdict latched at start, every
+    remaining stage then burned its timeout against a backend that
+    could no longer compile, losing stages a CPU fallback would have
+    completed.  Returns True when the backend is gone and the caller
+    should retry the stage once on the freshly latched CPU environment;
+    a healthy re-probe (or already-CPU mode) returns False — the
+    failure was the stage's own.
+    """
+    if res.get("ok"):
+        return False
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return False
+    if _probe_matmul():
+        return False
+    print("# backend died mid-ladder (re-probe failed); latching CPU "
+          "and retrying the failed stage", file=sys.stderr)
+    _latch_cpu_env()
+    return True
+
+
 def _parent_probe_and_latch() -> None:
     """Probe the accelerator ONCE, in the parent; latch the verdict for
     every child.
@@ -140,17 +200,7 @@ def _parent_probe_and_latch() -> None:
 
     locked = serialize_device_access()  # $POSEIDON_DEVICE_LOCK_TIMEOUT
     if locked:
-        try:
-            probe = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax,jax.numpy as jnp;"
-                 "print(float((jnp.ones((64,64))@jnp.ones((64,64))).sum()))"],
-                capture_output=True, text=True, timeout=300,
-            )
-            # ones(64,64) @ ones(64,64) sums to 64**3 = 262144.
-            ok = probe.returncode == 0 and "262144" in probe.stdout
-        except subprocess.TimeoutExpired:
-            ok = False
+        ok = _probe_matmul()
     else:
         # Another process owns the chip and is not yielding: CPU fallback
         # beats racing it (the race wedges the tunnel for both).
@@ -162,19 +212,14 @@ def _parent_probe_and_latch() -> None:
         print("# accelerator probe ok; children skip probing",
               file=sys.stderr)
         return
-    env = clean_cpu_env(os.path.dirname(os.path.abspath(__file__)))
-    env["POSEIDON_BENCH_NO_PROBE"] = "1"
     print("# accelerator unreachable; latching CPU for all children",
           file=sys.stderr)
-    os.environ.clear()
-    os.environ.update(env)
-    # This process will never touch the chip again: holding the
-    # exclusive flock through an hours-long CPU ladder would block any
-    # recovered tunnel's real users (service, tools) behind a bench
-    # that no longer wants the hardware.
-    from poseidon_tpu.utils.envutil import release_device_lock
-
-    release_device_lock()
+    # The latch also releases the flock: this process will never touch
+    # the chip again, and holding the exclusive lock through an
+    # hours-long CPU ladder would block any recovered tunnel's real
+    # users (service, tools) behind a bench that no longer wants the
+    # hardware.
+    _latch_cpu_env()
 
 
 def _ensure_live_backend() -> None:
@@ -847,8 +892,17 @@ def main(argv=None) -> int:
             build_artifact(rungs, target, parity, trace, features)
         ), flush=True)
 
+    def _stage(mode, argv, timeout):
+        """One bench stage with the mid-ladder backend recheck: a stage
+        that fails while the accelerator verdict is latched triggers one
+        re-probe, and a dead backend retries the stage once on CPU."""
+        res = _child(mode, argv, timeout)
+        if _stage_failed_recheck(res):
+            res = _child(mode, argv, timeout)
+        return res
+
     def run_rung_child(machines, tasks):
-        res = _child("rung", [
+        res = _stage("rung", [
             "--machines", str(machines), "--tasks", str(tasks),
             "--ecs", str(args.ecs), "--rounds", str(args.rounds),
         ] + (["--verbose"] if args.verbose else []), RUNG_TIMEOUT_S)
@@ -863,7 +917,7 @@ def main(argv=None) -> int:
         return res
 
     emit()  # a valid (empty-ladder) line exists before any child runs
-    parity = _child("parity", [], PARITY_TIMEOUT_S)
+    parity = _stage("parity", [], PARITY_TIMEOUT_S)
     emit()
 
     # North-star rung FIRST: it is the scored number and must get the
@@ -879,7 +933,7 @@ def main(argv=None) -> int:
         t_machines, t_tasks = ladder[0]
     else:
         t_machines, t_tasks = 1_000, 10_000  # modest, completable sizing
-    trace = _child("trace", [
+    trace = _stage("trace", [
         "--machines", str(t_machines), "--tasks", str(t_tasks),
         "--rounds", str(max(args.rounds * 4, 12)),
     ], RUNG_TIMEOUT_S)
@@ -892,7 +946,7 @@ def main(argv=None) -> int:
         # the reference's behavior claims are cluster-scale claims, and
         # the semantic predicates (zero violations, whole gangs) now
         # hold at the scale the project's headline claims.
-        features = _child("features", [
+        features = _stage("features", [
             "--machines", "10000", "--rounds", "3",
         ], FEATURES_TIMEOUT_S)
         emit()
