@@ -770,6 +770,39 @@ def build_artifact(rungs, target, parity, trace, features) -> dict:
     return out
 
 
+def _load_last_live_tpu(target):
+    """Most recent committed live-TPU rung at ``target`` from
+    ``out/tpu_bench.jsonl``, or None.
+
+    Evidence pointer, NEVER the score: when a bench run cannot reach
+    the accelerator (dead tunnel / dead compile service), the driver
+    attaches this to the artifact so the record of a hardware-validated
+    north-star number travels with it; the score fields reflect only
+    what the run itself measured.  Called ONCE per run by the driver —
+    ``build_artifact`` stays pure (the scoring tests depend on that).
+    Lines are scanned newest-first: the file holds one superset line
+    per completed stage, and a later capture that died before its 10k
+    rung must not hide an earlier line's completed one."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "out", "tpu_bench.jsonl"
+    )
+    try:
+        with open(path, encoding="utf-8") as fh:
+            lines = [ln for ln in fh if ln.startswith("{")]
+    except Exception:  # noqa: BLE001 - evidence is optional; never fatal
+        return None
+    for ln in reversed(lines):
+        try:  # one corrupt line must not hide older good ones
+            for r in json.loads(ln).get("ladder", []):
+                if (r.get("backend") == "tpu" and r.get("ok")
+                        and (r.get("machines"), r.get("tasks"))
+                        == tuple(target)):
+                    return {"mtime": int(os.path.getmtime(path)), **r}
+        except Exception:  # noqa: BLE001
+            continue
+    return None
+
+
 def _child(mode: str, argv: list, timeout: int) -> dict:
     """Run one rung/parity in a subprocess; never raises.
 
@@ -887,10 +920,13 @@ def main(argv=None) -> int:
     trace = {"ok": False, "error": "not run"}
     features = {"ok": False, "error": "not run"}
 
+    live_evidence = _load_last_live_tpu(target)  # once; None when absent
+
     def emit():
-        print(json.dumps(
-            build_artifact(rungs, target, parity, trace, features)
-        ), flush=True)
+        art = build_artifact(rungs, target, parity, trace, features)
+        if art.get("backend") != "tpu" and live_evidence is not None:
+            art["last_live_tpu"] = live_evidence
+        print(json.dumps(art), flush=True)
 
     def _stage(mode, argv, timeout):
         """One bench stage with the mid-ladder backend recheck: a stage
