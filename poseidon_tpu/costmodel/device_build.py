@@ -1,0 +1,221 @@
+"""Device-side (jnp) cost-matrix construction for chained band solves.
+
+Why this exists: a wave's bands are chained — band k+1's costs depend
+on the machine load band k's flows commit — so solving two bands today
+costs two dispatches with a host round trip between them: fetch band
+k's flows, rebuild [E, M] cost/arc matrices in numpy, re-upload ~15-30
+MB through a tunnel whose per-transfer latency is 60-150 ms (measured
+live 2026-07-31).  Rebuilding the matrices ON DEVICE from band k's
+device-resident flows removes the fetch, the host build, and the
+re-upload from the critical path; the host ships only O(E + M) vectors
+and a bit-packed admissibility mask.
+
+Semantics mirror ``costmodel/cpu_mem.py`` (the reference deployment's
+active model, reference README.md:53-59) plus the per-column capacity
+denominator of ``graph/instance.py:_solve_banded``:
+
+- integer terms (fit mask, per-arc capacity, column capacity, slot
+  capacity) use int32 arithmetic — EXACTLY equal to the host build;
+- the load-derived cost surface uses float32 on device vs float64 on
+  host: entries can differ by +-1 normalized-cost unit at rounding
+  boundaries (~1e-3 of the cost range).  The chained solve's
+  optimality certificate is computed against the device-built matrix,
+  so solutions stay exactly certified for the instance they solved;
+  placement choices can differ from the host build by cost ties only.
+
+The admissibility mask (selectors, pod (anti-)affinity vs resident
+tasks) stays HOST-computed: it is label-set logic over Python dicts,
+F_A-independent, and ships as one [E, M] int8 plane.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from poseidon_tpu.costmodel import base
+from poseidon_tpu.costmodel.selectors import (
+    _matches,
+    pod_selector_admissibility,
+    selector_admissibility,
+)
+from poseidon_tpu.ops.transport import INF_COST
+
+_BIG_FIT = np.iinfo(np.int32).max // 4
+
+
+def extract_band_operands(ecs_b, mt, model) -> dict:
+    """Host-side, F_A-independent operands for ``device_cost_build``.
+
+    Everything here is computable before any earlier band's flows
+    exist, so it can be shipped to the device (or staged) while the
+    previous band is still solving.  ``model`` supplies the cpu_mem
+    blend/clip constants; the unsched escalator is evaluated here (it
+    depends only on wait counters).
+    """
+    E = ecs_b.num_ecs
+    unsched = (
+        model.unsched_base
+        + model.unsched_per_round * ecs_b.max_wait_rounds.astype(np.int64)
+    )
+    unsched = np.clip(unsched, 0, 8 * base.NORMALIZED_COST).astype(np.int32)
+
+    adm0 = selector_admissibility(ecs_b.selectors, mt.labels)
+    if mt.resident_kv is not None and ecs_b.pod_affinity is not None:
+        adm0 = adm0 & pod_selector_admissibility(
+            ecs_b.pod_affinity, ecs_b.pod_anti_affinity, ecs_b.labels,
+            mt.resident_kv, mt.resident_key, mt.resident_total,
+        )
+    anti_self = np.zeros(E, dtype=bool)
+    if ecs_b.pod_anti_affinity is not None and ecs_b.labels is not None:
+        for e, sels in enumerate(ecs_b.pod_anti_affinity):
+            if sels and any(_matches(ecs_b.labels[e], s) for s in sels):
+                anti_self[e] = True
+
+    cpu_obs = mt.cpu_obs_used if mt.cpu_obs_used is not None else mt.cpu_used
+    ram_obs = mt.ram_obs_used if mt.ram_obs_used is not None else mt.ram_used
+    return {
+        "cpu_req": ecs_b.cpu_request.astype(np.int32),
+        "ram_req": ecs_b.ram_request.astype(np.int32),
+        "unsched": unsched,
+        "adm0": adm0.astype(np.int8),
+        "anti_self": anti_self.astype(np.int8),
+        "cpu_cap": mt.cpu_capacity.astype(np.int32),
+        "ram_cap": mt.ram_capacity.astype(np.int32),
+        "cpu_used0": mt.cpu_used.astype(np.int32),
+        "ram_used0": mt.ram_used.astype(np.int32),
+        "cpu_obs0": cpu_obs.astype(np.int32),
+        "ram_obs0": ram_obs.astype(np.int32),
+        "cpu_util": mt.cpu_util.astype(np.float32),
+        "mem_util": mt.mem_util.astype(np.float32),
+        "slots_free0": mt.slots_free.astype(np.int32),
+        "measured_weight": np.float32(model.measured_weight),
+        "cpu_weight": np.float32(model.cpu_weight),
+    }
+
+
+def estimate_costs_host(ops) -> np.ndarray:
+    """Numpy estimate of the band's costs at ZERO committed delta.
+
+    Used only for ORDERING (the coarse column sort) and cost-range
+    validation by the chained wave path — the real matrix is built
+    in-program with the actual deltas.  Reuses the extracted operands
+    so the chain pays one admissibility pass, not two (the second full
+    cost_model.build was exactly the host work the chain removes)."""
+    adm0 = ops["adm0"].astype(bool)
+    cpu_req = ops["cpu_req"].astype(np.float64)[:, None]
+    ram_req = ops["ram_req"].astype(np.float64)[:, None]
+    cpu_capf = np.maximum(ops["cpu_cap"].astype(np.float64), 1.0)
+    ram_capf = np.maximum(ops["ram_cap"].astype(np.float64), 1.0)
+    cpu_free = ops["cpu_cap"] - ops["cpu_used0"]
+    ram_free = ops["ram_cap"] - ops["ram_used0"]
+    fits = (cpu_req <= cpu_free[None, :]) & (ram_req <= ram_free[None, :])
+    w = float(ops["measured_weight"])
+    wc = float(ops["cpu_weight"])
+    cpu_load = (
+        (1.0 - w) * (ops["cpu_obs0"][None, :] + cpu_req) / cpu_capf[None, :]
+        + w * ops["cpu_util"].astype(np.float64)[None, :]
+    )
+    mem_load = (
+        (1.0 - w) * (ops["ram_obs0"][None, :] + ram_req) / ram_capf[None, :]
+        + w * ops["mem_util"].astype(np.float64)[None, :]
+    )
+    load = wc * cpu_load + (1.0 - wc) * mem_load
+    costs = np.clip(
+        np.rint(load * base.NORMALIZED_COST), 0, 4 * base.NORMALIZED_COST
+    ).astype(np.int32)
+    return np.where(fits & adm0, costs, INF_COST).astype(np.int32)
+
+
+def device_cost_build(ops, delta_cpu, delta_ram, delta_slots):
+    """jnp cost build for one band given earlier bands' committed deltas.
+
+    ``delta_*`` are [M] int32 vectors of resources the ROUND's earlier
+    bands committed (zero for the first band): on device they come from
+    ``F_prev.T @ req_prev`` matvecs without any host round trip.
+
+    Returns ``(costs, arc_cap, capacity, col_cap)`` — the exact operand
+    set ``_solve_banded`` feeds a band's solve.  Traceable under jit on
+    any backend.
+    """
+    cpu_req = ops["cpu_req"][:, None]                       # [E, 1] i32
+    ram_req = ops["ram_req"][:, None]
+    adm0 = ops["adm0"].astype(bool)
+    cpu_committed = ops["cpu_used0"] + delta_cpu            # [M] i32
+    ram_committed = ops["ram_used0"] + delta_ram
+
+    # Fit: reservation-based free capacity, integer-exact.  RAW (can go
+    # negative on an overcommitted machine): the host compares against
+    # the signed value, so a zero-request row must NOT fit there.
+    cpu_free = (ops["cpu_cap"] - cpu_committed)[None, :]
+    ram_free = (ops["ram_cap"] - ram_committed)[None, :]
+    fits = (cpu_req <= cpu_free) & (ram_req <= ram_free)
+    admissible = fits & adm0
+
+    # Per-arc capacity: floor(free / req) per dimension, integer-exact
+    # (host uses np.floor of a float64 ratio — identical for int
+    # operands in range; the quotient is only consumed where
+    # ``admissible`` holds, which implies free >= req >= 0).
+    n_cpu = jnp.where(cpu_req > 0,
+                      jnp.maximum(cpu_free, 0) // jnp.maximum(cpu_req, 1),
+                      _BIG_FIT)
+    n_ram = jnp.where(ram_req > 0,
+                      jnp.maximum(ram_free, 0) // jnp.maximum(ram_req, 1),
+                      _BIG_FIT)
+    n_fit = jnp.minimum(jnp.minimum(n_cpu, n_ram), _BIG_FIT)
+    arc_cap = jnp.where(admissible, n_fit, 0).astype(jnp.int32)
+    # Anti-affinity to self = spreading: at most one member per machine.
+    arc_cap = jnp.where(
+        ops["anti_self"].astype(bool)[:, None],
+        jnp.minimum(arc_cap, 1), arc_cap,
+    )
+
+    # Load after placement (float32 on device; +-1 cost unit vs the
+    # host's float64 at rounding boundaries — see module docstring).
+    w = ops["measured_weight"]
+    wc = ops["cpu_weight"]
+    cpu_capf = jnp.maximum(ops["cpu_cap"].astype(jnp.float32), 1.0)
+    ram_capf = jnp.maximum(ops["ram_cap"].astype(jnp.float32), 1.0)
+    cpu_com = (ops["cpu_obs0"] + delta_cpu).astype(jnp.float32)
+    ram_com = (ops["ram_obs0"] + delta_ram).astype(jnp.float32)
+    cpu_load = (
+        (1.0 - w) * (cpu_com[None, :] + cpu_req.astype(jnp.float32))
+        / cpu_capf[None, :]
+        + w * ops["cpu_util"][None, :]
+    )
+    mem_load = (
+        (1.0 - w) * (ram_com[None, :] + ram_req.astype(jnp.float32))
+        / ram_capf[None, :]
+        + w * ops["mem_util"][None, :]
+    )
+    load = wc * cpu_load + (1.0 - wc) * mem_load
+    nc = jnp.float32(base.NORMALIZED_COST)
+    costs = jnp.clip(
+        jnp.rint(load * nc), 0, 4 * base.NORMALIZED_COST
+    ).astype(jnp.int32)
+    costs = jnp.where(admissible, costs, INF_COST).astype(jnp.int32)
+
+    # Slot capacity after earlier bands' placements.
+    capacity = jnp.maximum(ops["slots_free0"] - delta_slots, 0).astype(
+        jnp.int32
+    )
+
+    # Per-column resource-safe capacity (the _solve_banded denominator:
+    # the largest ADMISSIBLE request on each column bounds how many
+    # units the column can take within each dimension's free budget).
+    # int32 throughout: every operand (caps <= 2^26, requests <= 2^22,
+    # slot counts) fits with headroom, and TPUs have no native int64.
+    col_cap = capacity
+    for req, cap_arr, committed in (
+        (ops["cpu_req"], ops["cpu_cap"], cpu_committed),
+        (ops["ram_req"], ops["ram_cap"], ram_committed),
+    ):
+        denom = jnp.where(admissible, req[:, None], 0).max(axis=0)
+        free = jnp.maximum(cap_arr - committed, 0)
+        col_cap = jnp.where(
+            denom > 0,
+            jnp.minimum(col_cap, free // jnp.maximum(denom, 1)),
+            col_cap,
+        )
+    col_cap = jnp.clip(col_cap, 0, None).astype(jnp.int32)
+    return costs, arc_cap, capacity, col_cap

@@ -184,6 +184,50 @@ def _slice_ecs(ecs, idx: np.ndarray):
     )
 
 
+def _column_caps(ecs_b, cm, mt, committed_cpu, committed_ram,
+                 committed_net):
+    """Resource-safe column capacity (min over dimensions), with a
+    PER-COLUMN denominator: the largest request among rows actually
+    admissible on that column (selectors + fit, read off the cost
+    model's INF mask).  Sound — every unit a feasible flow puts on the
+    column consumes at most that denominator, so units <= free // denom
+    keeps the column within capacity — and strictly tighter than the
+    band-global max, which strands small machines whenever a large task
+    exists ANYWHERE in the band (a selector-pinned 2.8-core task on a
+    4-core node was starved by an 11.2-core task bound elsewhere: the
+    reference e2e resource-limits predicate,
+    poseidon_integration.go:294-407).  One definition shared by the
+    per-band loop and the chained wave path (its device twin is
+    costmodel.device_build)."""
+    adm = cm.costs < INF_COST                      # [E_b, M]
+    col_cap = cm.capacity.astype(np.int64)
+    for req, cap_arr, used in (
+        (ecs_b.cpu_request, mt.cpu_capacity, committed_cpu),
+        (ecs_b.ram_request, mt.ram_capacity, committed_ram),
+    ):
+        denom = np.where(adm, req.astype(np.int64)[:, None], 0)
+        denom = denom.max(axis=0)                   # [M]
+        free = np.maximum(cap_arr.astype(np.int64) - used, 0)
+        col_cap = np.where(
+            denom > 0,
+            np.minimum(col_cap, free // np.maximum(denom, 1)),
+            col_cap,
+        )
+    net_req = ecs_b.net_rx()
+    if mt.net_rx_capacity is not None:
+        raw = mt.net_rx_capacity.astype(np.int64)
+        denom = np.where(
+            adm, net_req.astype(np.int64)[:, None], 0
+        ).max(axis=0)
+        free = np.maximum(raw - committed_net, 0)
+        col_cap = np.where(
+            (raw > 0) & (denom > 0),
+            np.minimum(col_cap, free // np.maximum(denom, 1)),
+            col_cap,
+        )
+    return np.clip(col_cap, 0, None).astype(np.int32), net_req
+
+
 _ASSIGN_POOL = None
 
 
@@ -903,6 +947,13 @@ class RoundPlanner:
         gap = 0.0
         iters = 0
         remaining = sorted(set(bands.tolist()))
+        if len(remaining) > 1:
+            chained = self._try_chained_wave(
+                ecs, mt, bands, remaining, committed_cpu, committed_ram,
+                committed_net, base_slots, flows_full, metrics, on_band,
+            )
+            if chained is not None:
+                return chained
         while remaining:
             n_bands, idx = self._next_band_group(
                 remaining, bands, ecs, mt, committed_cpu, committed_ram,
@@ -918,45 +969,9 @@ class RoundPlanner:
             with _stage("round.cost_build"):
                 cm = self.cost_model.build(ecs_b, mt_b)
 
-            # Resource-safe column capacity (min over dimensions), with a
-            # PER-COLUMN denominator: the largest request among rows
-            # actually admissible on that column (selectors + fit, read
-            # off the cost model's INF mask).  Sound — every unit a
-            # feasible flow puts on the column consumes at most that
-            # denominator, so units <= free // denom keeps the column
-            # within capacity — and strictly tighter than the band-global
-            # max, which strands small machines whenever a large task
-            # exists ANYWHERE in the band (a selector-pinned 2.8-core
-            # task on a 4-core node was starved by an 11.2-core task
-            # bound elsewhere: the reference e2e resource-limits
-            # predicate, poseidon_integration.go:294-407).
-            adm = cm.costs < INF_COST                      # [E_b, M]
-            col_cap = cm.capacity.astype(np.int64)
-            for req, cap_arr, used in (
-                (ecs_b.cpu_request, mt.cpu_capacity, committed_cpu),
-                (ecs_b.ram_request, mt.ram_capacity, committed_ram),
-            ):
-                denom = np.where(adm, req.astype(np.int64)[:, None], 0)
-                denom = denom.max(axis=0)                   # [M]
-                free = np.maximum(cap_arr.astype(np.int64) - used, 0)
-                col_cap = np.where(
-                    denom > 0, np.minimum(col_cap, free // np.maximum(
-                        denom, 1
-                    )), col_cap,
-                )
-            net_req = ecs_b.net_rx()
-            if mt.net_rx_capacity is not None:
-                raw = mt.net_rx_capacity.astype(np.int64)
-                denom = np.where(
-                    adm, net_req.astype(np.int64)[:, None], 0
-                ).max(axis=0)
-                free = np.maximum(raw - committed_net, 0)
-                col_cap = np.where(
-                    (raw > 0) & (denom > 0),
-                    np.minimum(col_cap, free // np.maximum(denom, 1)),
-                    col_cap,
-                )
-            col_cap = np.clip(col_cap, 0, None).astype(np.int32)
+            col_cap, net_req = _column_caps(
+                ecs_b, cm, mt, committed_cpu, committed_ram, committed_net
+            )
 
             with _stage("round.solve_band"):
                 sol = self._solve_band(band, ecs_b, cm, col_cap, mt.uuids)
@@ -981,6 +996,124 @@ class RoundPlanner:
         metrics.objective = objective
         metrics.gap_bound = gap
         metrics.iterations = iters
+        return flows_full
+
+    def _try_chained_wave(self, ecs, mt, bands, remaining, committed_cpu,
+                          committed_ram, committed_net, base_slots,
+                          flows_full, metrics, on_band):
+        """Single-dispatch two-band wave (ops/transport_chained), or
+        None to fall through to the per-band loop.
+
+        Gates: POSEIDON_CHAINED=1, single device, auction solver,
+        cpu_mem model without the net dimension, no gang rows, exactly
+        two band GROUPS under the base-committed grouping gate, and no
+        usable warm frame for either group (fresh-wave territory —
+        warm churn rounds are answered by the host certificate or the
+        warm dispatch, both cheaper than a cold chained solve)."""
+        from poseidon_tpu.costmodel.cpu_mem import CpuMemCostModel
+        from poseidon_tpu.ops.transport_chained import (
+            chain_gate,
+            solve_wave_chained,
+        )
+
+        if not chain_gate():
+            return None
+        if (
+            self.solver_devices != 1
+            or self.flow_solver == "ssp"
+            or type(self.cost_model) is not CpuMemCostModel
+            # Zero net capacity means unknown/unlimited (MachineTable
+            # contract) and is inert in _column_caps; only REAL net
+            # bounds need the host path (no net dim on device yet).
+            or (mt.net_rx_capacity is not None
+                and bool(np.asarray(mt.net_rx_capacity).any()))
+            or (self.gang_scheduling and ecs.is_gang is not None
+                and bool(ecs.is_gang.any()))
+        ):
+            log.debug(
+                "chained wave: config gate declined (devices=%d solver=%s "
+                "model=%s net=%s gang=%s)", self.solver_devices,
+                self.flow_solver, type(self.cost_model).__name__,
+                mt.net_rx_capacity is not None,
+                ecs.is_gang is not None and bool(ecs.is_gang.any()),
+            )
+            return None
+        # Grouping under BASE commitment (an approximation of the
+        # loop's own gate, which re-evaluates after band 1 commits —
+        # grouping is a performance heuristic; capacity soundness is
+        # recomputed exactly on device for whatever partition we pick).
+        n1, idx1 = self._next_band_group(
+            remaining, bands, ecs, mt, committed_cpu, committed_ram,
+            committed_net,
+        )
+        rest = remaining[n1:]
+        if not rest:
+            return None  # single group: the plain fused path is ideal
+        n2, idx2 = self._next_band_group(
+            rest, bands, ecs, mt, committed_cpu, committed_ram,
+            committed_net,
+        )
+        if rest[n2:]:
+            log.debug("chained wave: >2 band groups; per-band path")
+            return None  # 3+ groups: chain covers the 2-band shape only
+        for key_band in (int(remaining[0]), int(rest[0])):
+            warm = self._warm_bands.get(key_band)
+            if warm is not None and self.incremental:
+                log.debug("chained wave: warm frame for band %d; "
+                          "warm path owns it", key_band)
+                return None  # a carried frame exists: warm path owns it
+        ecs_1 = _slice_ecs(ecs, idx1)
+        ecs_2 = _slice_ecs(ecs, idx2)
+        mt_b = _with_usage(
+            mt, committed_cpu, committed_ram, committed_net,
+            np.maximum(base_slots, 0).astype(np.int32),
+        )
+        cm1 = self.cost_model.build(ecs_1, mt_b)
+        col1, _ = _column_caps(
+            ecs_1, cm1, mt, committed_cpu, committed_ram, committed_net
+        )
+        from poseidon_tpu.costmodel.device_build import (
+            estimate_costs_host,
+            extract_band_operands,
+        )
+
+        ops2 = extract_band_operands(ecs_2, mt_b, self.cost_model)
+        est2 = estimate_costs_host(ops2)
+        out = solve_wave_chained(
+            cm1.costs, ecs_1.supply, col1, cm1.unsched_cost,
+            cm1.arc_capacity,
+            ecs_1.cpu_request.astype(np.int32),
+            ecs_1.ram_request.astype(np.int32),
+            ops2, ecs_2.supply, est2,
+            max_cost_hint=self.cost_model.max_cost(),
+            global_update_every=self.global_update_every,
+        )
+        if out is None:
+            return None
+        sol1, sol2, costs2 = out
+        flows_full[idx1] = sol1.flows
+        flows_full[idx2] = sol2.flows
+        metrics.objective = sol1.objective + sol2.objective
+        metrics.gap_bound = max(sol1.gap_bound, sol2.gap_bound)
+        metrics.iterations = sol1.iterations + sol2.iterations
+        metrics.bf_sweeps = sol1.bf_sweeps + sol2.bf_sweeps
+        if self.incremental:
+            for key_band, ecs_b, sol, costs_b, unsched_b in (
+                (int(remaining[0]), ecs_1, sol1, cm1.costs,
+                 cm1.unsched_cost),
+                (int(rest[0]), ecs_2, sol2, costs2, ops2["unsched"]),
+            ):
+                self._warm_bands[key_band] = _WarmState(
+                    ec_ids=list(ecs_b.ec_ids.tolist()),
+                    machine_uuids=list(mt.uuids),
+                    prices=sol.prices, flows=sol.flows,
+                    unsched=sol.unsched,
+                    costs=costs_b.astype(np.int64),
+                    unsched_cost=unsched_b.astype(np.int64),
+                )
+        if on_band is not None:
+            on_band(idx1, False, flows_full)
+            on_band(idx2, True, flows_full)
         return flows_full
 
     def _solve_band(self, band, ecs_b, cm, col_cap, machine_uuids):

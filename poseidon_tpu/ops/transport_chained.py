@@ -1,0 +1,424 @@
+"""Chained two-band wave solve: ONE device dispatch for a whole round.
+
+A fresh wave solves its size bands sequentially because band k+1's
+costs/capacities depend on the load band k commits (the resource-safe
+banding of graph/instance._solve_banded).  On the tunneled accelerator
+that chain costs two dispatches with a host round trip between them:
+fetch band 1's flow matrix, rebuild band 2's [E, M] matrices in numpy,
+re-upload them — ~4 transfer latency slots (60-150 ms each, measured
+live 2026-07-31) plus ~0.25 s of host build on the wave's critical
+path.
+
+This module runs the WHOLE two-band round as one jitted program:
+
+  band 1: coarse->fine pipeline (transport_coarse.coarse_to_fine_band)
+  deltas: F1^T @ requests (device matvec, no transfer)
+  band 2: costs/arc/column capacities built ON DEVICE from the deltas
+          (costmodel.device_build — integer surfaces exact, float32
+          load costs within +-1 unit of the host build), then its own
+          coarse->fine pipeline, aggregation done in-program over a
+          host-estimated column sort
+  results: both flow matrices ride ONE [E1+E2, M] fetch; both stat
+          vectors ride one more.
+
+Scope gates (callers fall back to the per-band host path): exactly two
+bands, cold (no usable warm frames — fresh-wave territory; warm churn
+rounds are answered by the host certificate without any dispatch), no
+gang rows (their atomicity repair is an interactive host loop), cpu_mem
+cost model without the net dimension, single-device solver.
+
+Enabled with POSEIDON_CHAINED=1 (default OFF until validated on real
+hardware; pure XLA — no Mosaic risk — but unproven against the live
+tunnel's compiler).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from poseidon_tpu.costmodel.device_build import device_cost_build
+from poseidon_tpu.ops.transport import (
+    INF_COST,
+    LADDER_FACTOR,
+    NUM_PHASES,
+    UNBOUNDED_ARC_CAP,
+    TransportSolution,
+    _host_finalize,
+    _host_validate,
+    _Telemetry,
+    coarse_sort_order,
+    padded_shape,
+)
+from poseidon_tpu.ops.transport_coarse import coarse_to_fine_band
+
+_AGG_LIM_BASE = 1 << 29
+
+
+def _aggregate_device(costs, capacity, arc_cap, perm, K, B):
+    """In-program twin of the host block aggregation
+    (transport_coarse.solve_transport_coarse_fused): rounded block-mean
+    costs, clipped block-sum capacities.  int32-exact vs the host for
+    in-range operands (costs <= 4*NORMALIZED_COST, B <= a few hundred)."""
+    E = costs.shape[0]
+    costs_s = jnp.take(costs, perm, axis=1).reshape(E, K, B)
+    adm = costs_s < INF_COST
+    n_adm = adm.sum(axis=-1)
+    csum = jnp.where(adm, costs_s, 0).sum(axis=-1)
+    Cg = jnp.where(
+        n_adm > 0, (csum + n_adm // 2) // jnp.maximum(n_adm, 1), INF_COST
+    ).astype(jnp.int32)
+    lim = _AGG_LIM_BASE // B
+    capg = jnp.minimum(
+        jnp.take(capacity, perm).reshape(K, B), lim
+    ).sum(axis=-1).astype(jnp.int32)
+    arcg = jnp.minimum(
+        jnp.where(adm, jnp.take(arc_cap, perm, axis=1).reshape(E, K, B), 0),
+        lim,
+    ).sum(axis=-1).astype(jnp.int32)
+    return Cg, capg, arcg
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("groups", "block", "max_iter", "scale"),
+)
+def _chained_wave_device(
+    bigA, coarse3A, vecA, reqA, opsB, vecB,
+    *, groups, block, max_iter, scale,
+):
+    """The one-dispatch two-band program.  Operand layout:
+
+    - ``bigA`` [2, E1, M2]: band-1 costs + arc capacity;
+    - ``coarse3A`` [3, E1, K]: band-1 host-aggregated coarse instance;
+    - ``vecA``: band-1 packed vector, identical layout to
+      transport_coarse._coarse_fused_device's ``vec``;
+    - ``reqA`` [2, E1]: band-1 per-EC cpu/ram requests (delta matvecs);
+    - ``opsB``: device_cost_build operand dict, padded to [E2, M2]/[M2];
+    - ``vecB``: supplyB | permB | invpermB | unused | eps_sched_coarseB
+      | [eps_capB, mitB, geB, bfmaxB].
+    """
+    _, E1, M2 = bigA.shape
+    K, B = groups, block
+    o = 0
+    supplyA = vecA[o:o + E1]; o += E1                     # noqa: E702
+    capacityA = vecA[o:o + M2]; o += M2                   # noqa: E702
+    unschedA = vecA[o:o + E1]; o += E1                    # noqa: E702
+    permA = vecA[o:o + M2]; o += M2                       # noqa: E702
+    invpermA = vecA[o:o + M2]; o += M2                    # noqa: E702
+    capgA = vecA[o:o + K]; o += K                         # noqa: E702
+    seedpA = vecA[o:o + E1 + K + 1]; o += E1 + K + 1      # noqa: E702
+    seedfbA = vecA[o:o + E1]; o += E1                     # noqa: E702
+    epsschedA = vecA[o:o + NUM_PHASES]; o += NUM_PHASES   # noqa: E702
+    eps_capA = vecA[o]
+    mitA = vecA[o + 1]
+    geA = vecA[o + 2]
+    bfmaxA = vecA[o + 3]
+
+    (F1, fb1, prices1, it1, bf1, clean1, pi1,
+     itc1, _bfc1, _cc1, _eps1) = coarse_to_fine_band(
+        bigA[0], bigA[1], capacityA, supplyA, unschedA, permA, invpermA,
+        coarse3A[0], capgA, coarse3A[1], coarse3A[2], seedpA, seedfbA,
+        epsschedA, eps_capA, mitA, geA, bfmaxA,
+        groups=K, block=B, max_iter=max_iter, scale=scale,
+    )
+
+    # ---- committed deltas, entirely on device (the chain's point).
+    delta_cpu = (F1 * reqA[0][:, None]).sum(axis=0).astype(jnp.int32)
+    delta_ram = (F1 * reqA[1][:, None]).sum(axis=0).astype(jnp.int32)
+    delta_slots = F1.sum(axis=0).astype(jnp.int32)
+
+    costsB, arcB, _slotsB, colB = device_cost_build(
+        opsB, delta_cpu, delta_ram, delta_slots
+    )
+    E2 = costsB.shape[0]
+    o = 0
+    supplyB = vecB[o:o + E2]; o += E2                     # noqa: E702
+    permB = vecB[o:o + M2]; o += M2                       # noqa: E702
+    invpermB = vecB[o:o + M2]; o += M2                    # noqa: E702
+    epsschedB = vecB[o:o + NUM_PHASES]; o += NUM_PHASES   # noqa: E702
+    eps_capB = vecB[o]
+    mitB = vecB[o + 1]
+    geB = vecB[o + 2]
+    bfmaxB = vecB[o + 3]
+    unschedB = opsB["unsched"]
+
+    CgB, capgB, arcgB = _aggregate_device(costsB, colB, arcB, permB, K, B)
+    zeros_p = jnp.zeros(E2 + K + 1, dtype=jnp.int32)
+    zeros_f = jnp.zeros((E2, K), dtype=jnp.int32)
+    zeros_fb = jnp.zeros(E2, dtype=jnp.int32)
+    (F2, fb2, prices2, it2, bf2, clean2, pi2,
+     itc2, _bfc2, _cc2, _eps2) = coarse_to_fine_band(
+        costsB, arcB, colB, supplyB, unschedB, permB, invpermB,
+        CgB, capgB, arcgB, zeros_f, zeros_p, zeros_fb,
+        epsschedB, eps_capB, mitB, geB, bfmaxB,
+        groups=K, block=B, max_iter=max_iter, scale=scale,
+    )
+
+    # ---- pack: both flow matrices in ONE fetch, both stat vectors in
+    # another.  costsB rides home with the stats so the host can
+    # certify/commit against the matrix the device actually solved.
+    flows = jnp.concatenate([F1, F2], axis=0)             # [E1+E2, M2]
+    small = jnp.concatenate([
+        fb1.astype(jnp.int32), prices1.astype(jnp.int32),
+        jnp.stack([it1 + itc1, bf1, clean1]).astype(jnp.int32),
+        pi1.astype(jnp.int32),
+        fb2.astype(jnp.int32), prices2.astype(jnp.int32),
+        jnp.stack([it2 + itc2, bf2, clean2]).astype(jnp.int32),
+        pi2.astype(jnp.int32),
+    ])
+    return flows, small, costsB, arcB, colB
+
+
+def chain_gate(env=None) -> bool:
+    import os
+
+    return (env or os.environ).get("POSEIDON_CHAINED", "0") == "1"
+
+
+def solve_wave_chained(
+    costs1: np.ndarray,
+    supply1: np.ndarray,
+    col_cap1: np.ndarray,
+    unsched1: np.ndarray,
+    arc_cap1: Optional[np.ndarray],
+    req1_cpu: np.ndarray,
+    req1_ram: np.ndarray,
+    ops2: dict,
+    supply2: np.ndarray,
+    est_costs2: np.ndarray,
+    *,
+    max_cost_hint: int,
+    max_iter_per_phase: int = 8192,
+    max_iter_total: int = 8192,
+    global_update_every: int = 4,
+    bf_max: int = 64,
+) -> Optional[Tuple[TransportSolution, TransportSolution, np.ndarray]]:
+    """Host wrapper: pack, dispatch once, certify both bands.
+
+    ``ops2`` comes from costmodel.device_build.extract_band_operands
+    (unpadded); ``est_costs2`` is the host's F1-independent estimate of
+    band 2's costs (base committed load), used ONLY for the column
+    sort (block homogeneity) and the validation's cost-range check —
+    the real matrix is built in-program and returned for certification.
+
+    Returns ``(sol1, sol2, costs2)`` or None on decline (shape gates)
+    or a non-converged band (callers rerun the plain per-band path).
+    """
+    from poseidon_tpu.ops.transport import (
+        coarse_group_count,
+        derive_scale,
+    )
+
+    E1, M = costs1.shape
+    E2 = ops2["cpu_req"].shape[0]
+    if E1 == 0 or E2 == 0 or M == 0:
+        return None
+    e1_pad, m_pad = padded_shape(E1, M)
+    e2_pad, m_pad2 = padded_shape(E2, M)
+    if m_pad2 != m_pad:
+        return None  # same machine axis must pad identically
+    K = coarse_group_count(m_pad, None)
+    if K is None or K >= m_pad:
+        return None
+    B = -(-m_pad // K)
+    M2 = K * B
+    scale, _ = derive_scale(
+        costs1, unsched1, max_cost_hint, e1_pad, m_pad
+    )
+
+    # ---- band 1 padded operands (layout mirrors the fused path).
+    bigA = np.empty((2, e1_pad, M2), dtype=np.int32)
+    bigA[0].fill(INF_COST)
+    bigA[0][:E1, :M] = costs1
+    bigA[1].fill(0)
+    bigA[1][:E1, :M] = (
+        arc_cap1 if arc_cap1 is not None else UNBOUNDED_ARC_CAP
+    )
+    supply1_p = np.zeros(e1_pad, dtype=np.int32)
+    supply1_p[:E1] = supply1
+    unsched1_p = np.ones(e1_pad, dtype=np.int32)
+    unsched1_p[:E1] = unsched1
+    cap1_p = np.zeros(M2, dtype=np.int32)
+    cap1_p[:M] = col_cap1
+    _host_validate(
+        bigA[0], supply1_p, cap1_p, unsched1_p, scale, None, max_cost_hint
+    )
+    permA = coarse_sort_order(bigA[0]).astype(np.int32)
+    invpermA = np.argsort(permA).astype(np.int32)
+
+    from poseidon_tpu.ops.transport import maybe_greedy_start
+    from poseidon_tpu.ops.transport_coarse import host_aggregate
+
+    CgA, capgA, arcgA = host_aggregate(
+        bigA[0], cap1_p, bigA[1], permA, K, B
+    )
+    # Greedy seed for band 1's in-program coarse stage — same policy as
+    # the single-band fused wrapper (a cold coarse start pays 2-3x the
+    # iterations, the dominant device term on the tunnel).
+    gf_c, gfb_c, gp_c, geps_c = maybe_greedy_start(
+        True, None, None, None, None, CgA, supply1_p, capgA, arcgA,
+        unsched1_p, max_cost_hint, e1_pad, K, scale=scale,
+    )
+    if gp_c is None:
+        gf_c = np.zeros((e1_pad, K), dtype=np.int32)
+        gfb_c = np.zeros(e1_pad, dtype=np.int32)
+        gp_c = np.zeros(e1_pad + K + 1, dtype=np.int32)
+        geps_c = None  # cold coarse ladder
+    _, eps_sched_cA = _host_validate(
+        CgA, supply1_p, capgA, unsched1_p, scale, geps_c, max_cost_hint
+    )
+    finiteA = bigA[0][bigA[0] < INF_COST]
+    max_cA = int(max(finiteA.max() if finiteA.size else 1, 1)) * scale
+    coarse3A = np.stack([CgA, arcgA, gf_c.astype(np.int32)])
+    vecA = np.concatenate([
+        supply1_p, cap1_p, unsched1_p, permA, invpermA, capgA,
+        gp_c.astype(np.int32), gfb_c.astype(np.int32),
+        np.asarray(eps_sched_cA, dtype=np.int32),
+        np.asarray([
+            max(max_cA // 2, 1),
+            max(max_iter_total // 2, 1), global_update_every, bf_max,
+        ], dtype=np.int32),
+    ])
+
+    # ---- band 2 padded operands.
+    def pad_e(v, fill=0):
+        out = np.full(e2_pad, fill, dtype=np.asarray(v).dtype)
+        out[:E2] = v
+        return out
+
+    def pad_m(v, fill=0):
+        out = np.full(M2, fill, dtype=np.asarray(v).dtype)
+        out[:M] = v
+        return out
+
+    adm0 = np.zeros((e2_pad, M2), dtype=np.int8)
+    adm0[:E2, :M] = ops2["adm0"]
+    opsB = {
+        "cpu_req": pad_e(ops2["cpu_req"]),
+        "ram_req": pad_e(ops2["ram_req"]),
+        "unsched": pad_e(ops2["unsched"], fill=1),
+        "adm0": adm0,
+        "anti_self": pad_e(ops2["anti_self"]),
+        "cpu_cap": pad_m(ops2["cpu_cap"]),
+        "ram_cap": pad_m(ops2["ram_cap"]),
+        "cpu_used0": pad_m(ops2["cpu_used0"]),
+        "ram_used0": pad_m(ops2["ram_used0"]),
+        "cpu_obs0": pad_m(ops2["cpu_obs0"]),
+        "ram_obs0": pad_m(ops2["ram_obs0"]),
+        "cpu_util": pad_m(ops2["cpu_util"]),
+        "mem_util": pad_m(ops2["mem_util"]),
+        "slots_free0": pad_m(ops2["slots_free0"]),
+        "measured_weight": ops2["measured_weight"],
+        "cpu_weight": ops2["cpu_weight"],
+    }
+    supply2_p = np.zeros(e2_pad, dtype=np.int32)
+    supply2_p[:E2] = supply2
+    est_p = np.full((e2_pad, M2), INF_COST, dtype=np.int32)
+    est_p[:E2, :M] = est_costs2
+    # Validation on the estimate: scale safety and flow-mass headroom
+    # depend on supply/capacity (exact) and the cost RANGE (clipped to
+    # the model bound on device, so the hint covers the real matrix).
+    _host_validate(
+        est_p, supply2_p, pad_m(np.minimum(ops2["slots_free0"], 1 << 20)),
+        opsB["unsched"], scale, None, max_cost_hint,
+    )
+    permB = coarse_sort_order(est_p).astype(np.int32)
+    invpermB = np.argsort(permB).astype(np.int32)
+    eps0 = max(int(max_cost_hint) * scale // 2, 1)
+    rungs = [eps0]
+    for _ in range(NUM_PHASES - 1):
+        rungs.append(max(rungs[-1] // LADDER_FACTOR, 1))
+    vecB = np.concatenate([
+        supply2_p, permB, invpermB,
+        np.asarray(rungs, dtype=np.int32),
+        np.asarray([
+            eps0, max(max_iter_total // 2, 1), global_update_every,
+            bf_max,
+        ], dtype=np.int32),
+    ])
+
+    _Telemetry.device_calls += 1
+    try:
+        flows_d, small_d, costsB_d, arcB_d, colB_d = _chained_wave_device(
+            bigA, coarse3A, vecA,
+            np.stack([
+                pad_band_req(req1_cpu, e1_pad),
+                pad_band_req(req1_ram, e1_pad),
+            ]),
+            opsB, vecB,
+            groups=K, block=B, max_iter=max_iter_per_phase, scale=scale,
+        )
+        # Fetch inside the guard: dispatch is async, so execution and
+        # transfer errors surface at the first result read.
+        small = np.asarray(small_d)
+        flows = np.asarray(flows_d)
+        costs2 = np.asarray(costsB_d)[:E2, :M]
+        arc2 = np.asarray(arcB_d)[:E2, :M]
+        col2 = np.asarray(colB_d)[:M]
+    except Exception as e:  # noqa: BLE001 - decline, never fail the round
+        from poseidon_tpu.ops.transport import (
+            _is_transient_backend_error,
+        )
+        import logging
+
+        logging.getLogger("poseidon_tpu.transport_chained").warning(
+            "chained wave dispatch failed (%s: %s); declining to the "
+            "per-band path%s", type(e).__name__, str(e)[:200],
+            "" if _is_transient_backend_error(e) else
+            " (non-transient - investigate)",
+        )
+        return None
+
+    # ---- unpack band stats and certify each band host-side (the same
+    # _host_finalize the plain path uses; gap 0 required from both).
+    o = 0
+    fb1 = small[o:o + e1_pad]; o += e1_pad                # noqa: E702
+    pr1 = small[o:o + e1_pad + M2 + 1]; o += e1_pad + M2 + 1  # noqa: E702
+    it1, bf1, clean1 = small[o], small[o + 1], small[o + 2]; o += 3  # noqa: E702,E501
+    o += NUM_PHASES
+    fb2 = small[o:o + e2_pad]; o += e2_pad                # noqa: E702
+    pr2 = small[o:o + e2_pad + M2 + 1]; o += e2_pad + M2 + 1  # noqa: E702
+    it2, bf2, clean2 = small[o], small[o + 1], small[o + 2]; o += 3  # noqa: E702,E501
+
+    def unpack(prices, e_pad, E):
+        return np.concatenate([
+            prices[:E], prices[e_pad:e_pad + M], prices[e_pad + M2:],
+        ])
+
+    sol1 = _host_finalize(
+        flows[:E1, :M], fb1[:E1], unpack(pr1, e1_pad, E1), int(it1),
+        costs=costs1, supply=supply1, capacity=col_cap1,
+        unsched_cost=unsched1, scale=scale, clean=bool(clean1),
+        arc_capacity=(
+            arc_cap1 if arc_cap1 is not None
+            else np.full((E1, M), UNBOUNDED_ARC_CAP, np.int32)
+        ), bf_sweeps=int(bf1),
+    )
+    sol2 = _host_finalize(
+        flows[e1_pad:e1_pad + E2, :M], fb2[:E2],
+        unpack(pr2, e2_pad, E2), int(it2),
+        costs=costs2, supply=supply2, capacity=col2,
+        unsched_cost=ops2["unsched"], scale=scale, clean=bool(clean2),
+        arc_capacity=arc2, bf_sweeps=int(bf2),
+    )
+    if sol1.gap_bound != 0.0 or sol2.gap_bound != 0.0:
+        import logging
+
+        logging.getLogger("poseidon_tpu.transport_chained").info(
+            "chained wave declined: band gaps %.4g / %.4g (iters %d/%d) "
+            "- plain path re-solves", sol1.gap_bound, sol2.gap_bound,
+            sol1.iterations, sol2.iterations,
+        )
+        return None  # honest decline: the plain path re-solves
+    return sol1, sol2, costs2
+
+
+def pad_band_req(req: np.ndarray, e_pad: int) -> np.ndarray:
+    out = np.zeros(e_pad, dtype=np.int32)
+    out[:req.shape[0]] = req
+    return out

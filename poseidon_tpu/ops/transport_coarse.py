@@ -88,6 +88,33 @@ def _certified_eps_device(F, Ffb, prices, *, C, U, Uem, capacity, supply,
     return jnp.maximum(worst, 1)
 
 
+def host_aggregate(costs_p, capacity_p, arc_p, perm, K, B):
+    """Host block aggregation: rounded block-mean costs, clipped
+    block-sum capacities.  ONE definition — the fused single-band
+    wrapper, the chained two-band wrapper, and the in-program twin
+    (transport_chained._aggregate_device, int32-exact vs this for
+    in-range operands) must never diverge on it."""
+    E = costs_p.shape[0]
+    costs_srt = costs_p[:, perm].reshape(E, K, B)
+    adm_srt = costs_srt < INF_COST
+    n_adm = adm_srt.sum(axis=-1)
+    csum = np.where(adm_srt, costs_srt, 0).sum(axis=-1, dtype=np.int64)
+    Cg_h = np.where(
+        n_adm > 0, (csum + n_adm // 2) // np.maximum(n_adm, 1), INF_COST
+    ).astype(np.int32)
+    # Per-member clip scaled by the block size keeps the int32 sums
+    # exact at any B while "effectively unbounded" group capacities stay
+    # far above any feasible supply.
+    lim = (1 << 29) // B
+    capg_h = np.minimum(
+        capacity_p[perm].reshape(K, B), lim
+    ).sum(axis=-1).astype(np.int32)
+    arcg_h = np.minimum(
+        np.where(adm_srt, arc_p[:, perm].reshape(E, K, B), 0), lim
+    ).sum(axis=-1).astype(np.int32)
+    return Cg_h, capg_h, arcg_h
+
+
 @functools.partial(
     jax.jit, static_argnames=("groups", "block", "max_iter", "scale")
 )
@@ -129,6 +156,42 @@ def _coarse_fused_device(big, coarse3, vec,
     global_every = vec[o + 2]
     bf_max = vec[o + 3]
 
+    (F, Ffb, prices, iters, bf, clean, phase_iters,
+     it_c, bf_c, clean_c, eps) = coarse_to_fine_band(
+        costs, arc_cap, capacity, supply, unsched_cost, perm, inv_perm,
+        Cg, capg, arcg, seed_flows, seed_prices, seed_fb,
+        eps_sched_coarse, eps_cap, max_iter_total, global_every, bf_max,
+        groups=K, block=B, max_iter=max_iter, scale=scale,
+    )
+    small = jnp.concatenate([
+        Ffb.astype(jnp.int32),
+        prices.astype(jnp.int32),
+        jnp.stack([
+            iters.astype(jnp.int32), bf.astype(jnp.int32),
+            clean.astype(jnp.int32), it_c.astype(jnp.int32),
+            bf_c.astype(jnp.int32), clean_c.astype(jnp.int32),
+            eps.astype(jnp.int32),
+        ]),
+        phase_iters.astype(jnp.int32),
+    ])
+    return F, small
+
+
+def coarse_to_fine_band(costs, arc_cap, capacity, supply, unsched_cost,
+                        perm, inv_perm, Cg, capg, arcg, seed_flows,
+                        seed_prices, seed_fb, eps_sched_coarse, eps_cap,
+                        max_iter_total, global_every, bf_max,
+                        *, groups, block, max_iter, scale):
+    """The coarse->lift->disaggregate->certify->full-ladder pipeline as
+    a plain traced function over already-unpacked operands.
+
+    Factored out of the packed single-band dispatch so the CHAINED
+    two-band wave program (transport_chained) can run it once per band
+    inside one jit — with band 2's operands built on device from band
+    1's flows — without duplicating the disaggregation scan or the
+    certificate math."""
+    E, M = costs.shape
+    K, B = groups, block
     # ---- block views in sorted column space (for the disaggregation)
     costs_s = jnp.take(costs, perm, axis=1).reshape(E, K, B)
     cap_s = jnp.take(capacity, perm).reshape(K, B)
@@ -211,18 +274,8 @@ def _coarse_fused_device(big, coarse3, vec,
         jnp.maximum(max_iter_total - it_c, 1), global_every, bf_max,
         max_iter=max_iter, scale=scale,
     )
-    small = jnp.concatenate([
-        Ffb.astype(jnp.int32),
-        prices.astype(jnp.int32),
-        jnp.stack([
-            iters.astype(jnp.int32), bf.astype(jnp.int32),
-            clean.astype(jnp.int32), it_c.astype(jnp.int32),
-            bf_c.astype(jnp.int32), clean_c.astype(jnp.int32),
-            eps.astype(jnp.int32),
-        ]),
-        phase_iters.astype(jnp.int32),
-    ])
-    return F, small
+    return (F, Ffb, prices, iters, bf, clean, phase_iters,
+            it_c, bf_c, clean_c, eps)
 
 
 def solve_transport_coarse_fused(
@@ -325,23 +378,9 @@ def solve_transport_coarse_fused(
     # stage starts cold and pays 2-3x the iterations — per-op cost is
     # exactly the term the H1 hypothesis says dominates on the tunneled
     # accelerator.
-    costs_srt = costs_p[:, perm].reshape(e_pad, K, B)
-    adm_srt = costs_srt < INF_COST
-    n_adm = adm_srt.sum(axis=-1)
-    csum = np.where(adm_srt, costs_srt, 0).sum(axis=-1, dtype=np.int64)
-    Cg_h = np.where(
-        n_adm > 0, (csum + n_adm // 2) // np.maximum(n_adm, 1), INF_COST
-    ).astype(np.int32)
-    # Per-member clip scaled by the block size keeps the int32 sums
-    # exact at any B while "effectively unbounded" group capacities stay
-    # far above any feasible supply.
-    lim = (1 << 29) // B
-    capg_h = np.minimum(
-        capacity_p[perm].reshape(K, B), lim
-    ).sum(axis=-1).astype(np.int32)
-    arcg_h = np.minimum(
-        np.where(adm_srt, arc_p[:, perm].reshape(e_pad, K, B), 0), lim
-    ).sum(axis=-1).astype(np.int32)
+    Cg_h, capg_h, arcg_h = host_aggregate(
+        costs_p, capacity_p, arc_p, perm, K, B
+    )
     gf_c, gfb_c, gp_c, geps_c = maybe_greedy_start(
         True, None, None, None, None, Cg_h, supply_p, capg_h, arcg_h,
         unsched_p, max_cost_hint, e_pad, K, scale=scale,

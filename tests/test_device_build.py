@@ -1,0 +1,142 @@
+"""Device-side cost build vs the host cpu_mem build + _solve_banded
+column capacities: integer surfaces EXACT, float-derived costs within
+one normalized-cost unit (float32 on device vs float64 on host)."""
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.costmodel.base import ECTable, MachineTable
+from poseidon_tpu.costmodel.cpu_mem import CpuMemCostModel
+from poseidon_tpu.costmodel.device_build import (
+    device_cost_build,
+    extract_band_operands,
+)
+from poseidon_tpu.ops.transport import INF_COST
+
+
+def _tables(rng, E, M, *, obs=False, selectors=False, waits=False):
+    ecs = ECTable(
+        ec_ids=np.arange(E, dtype=np.uint64),
+        cpu_request=rng.integers(0, 4000, size=E).astype(np.int64),
+        ram_request=rng.integers(1 << 16, 1 << 22, size=E).astype(np.int64),
+        supply=rng.integers(1, 8, size=E).astype(np.int32),
+        priority=np.zeros(E, dtype=np.int32),
+        task_type=np.zeros(E, dtype=np.int32),
+        max_wait_rounds=(
+            rng.integers(0, 40, size=E).astype(np.int32) if waits
+            else np.zeros(E, dtype=np.int32)
+        ),
+        selectors=[
+            ((0, "zone", ("a",)),) if selectors and i % 3 == 0 else ()
+            for i in range(E)
+        ],
+    )
+    labels = [
+        {"zone": "a" if m % 2 == 0 else "b"} for m in range(M)
+    ]
+    cpu_cap = rng.integers(4000, 64000, size=M).astype(np.int64)
+    ram_cap = rng.integers(1 << 22, 1 << 26, size=M).astype(np.int64)
+    cpu_used = (cpu_cap * rng.random(M) * 0.8).astype(np.int64)
+    ram_used = (ram_cap * rng.random(M) * 0.8).astype(np.int64)
+    mt = MachineTable(
+        uuids=[f"m{m}" for m in range(M)],
+        cpu_capacity=cpu_cap, ram_capacity=ram_cap,
+        cpu_used=cpu_used, ram_used=ram_used,
+        cpu_util=rng.random(M).astype(np.float32),
+        mem_util=rng.random(M).astype(np.float32),
+        slots_free=rng.integers(0, 64, size=M).astype(np.int32),
+        labels=labels,
+    )
+    if obs:
+        mt.cpu_obs_used = (cpu_used * rng.uniform(0.5, 1.5, M)).astype(
+            np.int64
+        )
+        mt.ram_obs_used = (ram_used * rng.uniform(0.5, 1.5, M)).astype(
+            np.int64
+        )
+    return ecs, mt
+
+
+def _host_reference(ecs, mt, model, delta_cpu, delta_ram, delta_slots):
+    """What _solve_banded computes: cost build at the committed view +
+    the per-column capacity denominator."""
+    from dataclasses import replace
+
+    committed_cpu = mt.cpu_used + delta_cpu
+    committed_ram = mt.ram_used + delta_ram
+    kw = {}
+    if mt.cpu_obs_used is not None:
+        kw["cpu_obs_used"] = mt.cpu_obs_used + delta_cpu
+    if mt.ram_obs_used is not None:
+        kw["ram_obs_used"] = mt.ram_obs_used + delta_ram
+    mt_b = replace(
+        mt, cpu_used=committed_cpu, ram_used=committed_ram,
+        slots_free=np.maximum(mt.slots_free - delta_slots, 0).astype(
+            np.int32
+        ), **kw,
+    )
+    cm = model.build(ecs, mt_b)
+    adm = cm.costs < INF_COST
+    col_cap = cm.capacity.astype(np.int64)
+    for req, cap_arr, used in (
+        (ecs.cpu_request, mt.cpu_capacity, committed_cpu),
+        (ecs.ram_request, mt.ram_capacity, committed_ram),
+    ):
+        denom = np.where(adm, req.astype(np.int64)[:, None], 0).max(axis=0)
+        free = np.maximum(cap_arr.astype(np.int64) - used, 0)
+        col_cap = np.where(
+            denom > 0, np.minimum(col_cap, free // np.maximum(denom, 1)),
+            col_cap,
+        )
+    return cm, np.clip(col_cap, 0, None).astype(np.int32)
+
+
+@pytest.mark.parametrize("seed,obs,selectors,waits", [
+    (0, False, False, False),
+    (1, True, False, True),
+    (2, False, True, False),
+    (3, True, True, True),
+])
+def test_device_build_matches_host(seed, obs, selectors, waits):
+    rng = np.random.default_rng(seed)
+    E, M = 24, 60
+    model = CpuMemCostModel()
+    ecs, mt = _tables(rng, E, M, obs=obs, selectors=selectors, waits=waits)
+    # Simulate an earlier band's committed load.
+    delta_cpu = rng.integers(0, 2000, size=M).astype(np.int64)
+    delta_ram = rng.integers(0, 1 << 20, size=M).astype(np.int64)
+    delta_slots = rng.integers(0, 8, size=M).astype(np.int64)
+
+    cm, col_ref = _host_reference(
+        ecs, mt, model, delta_cpu, delta_ram, delta_slots
+    )
+    ops = extract_band_operands(ecs, mt, model)
+    costs, arc, capacity, col = (
+        np.asarray(x) for x in device_cost_build(
+            ops, delta_cpu.astype(np.int32), delta_ram.astype(np.int32),
+            delta_slots.astype(np.int32),
+        )
+    )
+
+    # Integer surfaces: EXACT.
+    np.testing.assert_array_equal(arc, cm.arc_capacity)
+    np.testing.assert_array_equal(capacity, cm.capacity)
+    np.testing.assert_array_equal(col, col_ref)
+    # Admissibility (INF placement) must agree everywhere.
+    np.testing.assert_array_equal(costs >= INF_COST, cm.costs >= INF_COST)
+    # Float-derived finite costs: within one normalized unit.
+    finite = cm.costs < INF_COST
+    diff = np.abs(
+        costs.astype(np.int64)[finite] - cm.costs.astype(np.int64)[finite]
+    )
+    assert diff.max(initial=0) <= 1
+    assert (diff > 0).mean() < 0.02 if diff.size else True
+
+
+def test_device_build_unsched_escalator():
+    rng = np.random.default_rng(9)
+    ecs, mt = _tables(rng, 8, 10, waits=True)
+    model = CpuMemCostModel()
+    ops = extract_band_operands(ecs, mt, model)
+    cm = model.build(ecs, mt)
+    np.testing.assert_array_equal(ops["unsched"], cm.unsched_cost)

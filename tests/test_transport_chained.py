@@ -1,0 +1,92 @@
+"""Chained single-dispatch two-band wave vs the per-band host path.
+
+Integer surfaces (placements, feasibility, convergence) must agree
+exactly; objectives may differ by at most one normalized-cost unit per
+placed task (band 2's costs are built in float32 on device vs float64
+on host — see costmodel/device_build.py)."""
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.costmodel.cpu_mem import CpuMemCostModel
+from poseidon_tpu.graph.instance import RoundPlanner
+from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+from poseidon_tpu.utils.ids import generate_uuid, task_uid
+
+
+def _mixed_state(machines=260, big=20, small=500, cpu_cap=64000):
+    st = ClusterState()
+    for i in range(machines):
+        st.node_added(MachineInfo(
+            uuid=generate_uuid(f"ch{i}"), cpu_capacity=cpu_cap,
+            ram_capacity=1 << 26, task_slots=48,
+        ))
+    for i in range(big):
+        st.task_submitted(TaskInfo(
+            uid=task_uid("big", i), job_id="big",
+            cpu_request=8000, ram_request=1 << 22,
+        ))
+    for i in range(small):
+        st.task_submitted(TaskInfo(
+            uid=task_uid("small", i), job_id="small",
+            cpu_request=150 + 10 * (i % 7), ram_request=1 << 18,
+        ))
+    return st
+
+
+def _round(monkeypatch, chained):
+    monkeypatch.setenv("POSEIDON_CHAINED", "1" if chained else "0")
+    monkeypatch.setenv("POSEIDON_HOST_CERT", "0")
+    st = _mixed_state()
+    planner = RoundPlanner(st, CpuMemCostModel())
+    deltas, m = planner.schedule_round()
+    return st, planner, deltas, m
+
+
+def test_chained_matches_per_band(monkeypatch):
+    st_a, _, deltas_a, m_a = _round(monkeypatch, chained=False)
+    st_b, _, deltas_b, m_b = _round(monkeypatch, chained=True)
+
+    assert m_b.converged and m_a.converged
+    assert m_b.gap_bound == 0.0
+    assert m_b.placed == m_a.placed == 520
+    assert m_b.unscheduled == m_a.unscheduled == 0
+    # One dispatch for the whole round (the chained program), vs >= 2.
+    assert m_b.device_calls == 1
+    assert m_a.device_calls >= 2
+    # Objective: within one cost unit per placed task (float32 band-2
+    # cost build), and typically equal.
+    assert abs(m_b.objective - m_a.objective) <= m_b.placed
+
+
+def test_chained_declines_with_gangs(monkeypatch):
+    monkeypatch.setenv("POSEIDON_CHAINED", "1")
+    monkeypatch.setenv("POSEIDON_HOST_CERT", "0")
+    st = _mixed_state(big=6, small=300)
+    for i in range(4):
+        st.task_submitted(TaskInfo(
+            uid=task_uid("gang", i), job_id="gangjob",
+            cpu_request=2000, ram_request=1 << 20, gang=True,
+            labels={"gangScheduling": "true"},
+        ))
+    planner = RoundPlanner(st, CpuMemCostModel())
+    deltas, m = planner.schedule_round()
+    # Gated off: the per-band path runs (>= 2 dispatches) and the gang
+    # places atomically.
+    assert m.device_calls >= 2
+    assert m.converged
+
+
+def test_chained_warm_frames_route_next_round(monkeypatch):
+    """After a chained round, the saved warm frames must be usable by
+    the NORMAL path on the next (churn-free) round: same placements,
+    zero additional iterations."""
+    monkeypatch.setenv("POSEIDON_CHAINED", "1")
+    monkeypatch.setenv("POSEIDON_HOST_CERT", "0")
+    st = _mixed_state()
+    planner = RoundPlanner(st, CpuMemCostModel())
+    _, m1 = planner.schedule_round()
+    assert m1.converged and m1.device_calls == 1
+    # Quiet round: nothing changed.
+    _, m2 = planner.schedule_round()
+    assert m2.iterations == 0
