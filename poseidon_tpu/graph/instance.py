@@ -1004,12 +1004,13 @@ class RoundPlanner:
         """Single-dispatch two-band wave (ops/transport_chained), or
         None to fall through to the per-band loop.
 
-        Gates: POSEIDON_CHAINED=1, single device, auction solver,
-        cpu_mem model without the net dimension, no gang rows, exactly
-        two band GROUPS under the base-committed grouping gate, and no
-        usable warm frame for either group (fresh-wave territory —
-        warm churn rounds are answered by the host certificate or the
-        warm dispatch, both cheaper than a cold chained solve)."""
+        Gates: chain_gate() (accelerator default ON; POSEIDON_CHAINED
+        forces 1/0), single device, auction solver, cpu_mem model
+        without real net bounds, no gang rows, exactly two band GROUPS
+        under the base-committed grouping gate, and no usable warm
+        frame for either group (fresh-wave territory — warm churn
+        rounds are answered by the host certificate or the warm
+        dispatch, both cheaper than a cold chained solve)."""
         from poseidon_tpu.costmodel.cpu_mem import CpuMemCostModel
         from poseidon_tpu.ops.transport_chained import (
             chain_gate,
@@ -1056,12 +1057,31 @@ class RoundPlanner:
         if rest[n2:]:
             log.debug("chained wave: >2 band groups; per-band path")
             return None  # 3+ groups: chain covers the 2-band shape only
-        for key_band in (int(remaining[0]), int(rest[0])):
-            warm = self._warm_bands.get(key_band)
-            if warm is not None and self.incremental:
-                log.debug("chained wave: warm frame for band %d; "
-                          "warm path owns it", key_band)
-                return None  # a carried frame exists: warm path owns it
+        if self.incremental:
+            uuid_set_now = set(mt.uuids)
+            for key_band, idx in (
+                (int(remaining[0]), idx1), (int(rest[0]), idx2),
+            ):
+                warm = self._warm_bands.get(key_band)
+                if warm is None:
+                    continue
+                # USABILITY, not presence: a frame stranded by EC churn
+                # (every fresh wave after a drain) remaps to a cold
+                # start anyway.  Full overlap is a set containment over
+                # ids — O(E + M), no array gathers (the O(E*M) remap
+                # runs once, in _solve_band, only when the warm path
+                # actually owns the round).  Conservative on purpose:
+                # a full-overlap frame signals churn, where the warm/
+                # selective/host-cert machinery beats re-solving BOTH
+                # bands cold even when cost drift later forces this
+                # band's own solve cold.
+                ids_now = set(ecs.ec_ids[idx].tolist())
+                if (warm.prices is not None
+                        and ids_now <= set(warm.ec_ids)
+                        and uuid_set_now <= set(warm.machine_uuids)):
+                    log.debug("chained wave: usable warm frame for band "
+                              "%d; warm path owns it", key_band)
+                    return None
         ecs_1 = _slice_ecs(ecs, idx1)
         ecs_2 = _slice_ecs(ecs, idx2)
         mt_b = _with_usage(

@@ -174,10 +174,18 @@ def _chained_wave_device(
     return flows, small, costsB, arcB, colB
 
 
-def chain_gate(env=None) -> bool:
-    import os
+def chain_gate() -> bool:
+    """Accelerator-default policy gate (POSEIDON_CHAINED=1/0 forces).
 
-    return (env or os.environ).get("POSEIDON_CHAINED", "0") == "1"
+    Default ON for accelerator backends: the chain's win is the
+    tunnel's per-transfer latency and the inter-band host rebuild; on
+    CPU it is wall-clock-neutral (measured at 10k/100k), so the plain
+    per-band path stays the CPU default.  Any dispatch failure on an
+    unproven backend declines to the per-band path (the guard in
+    solve_wave_chained), so the accel default is fail-safe."""
+    from poseidon_tpu.ops.transport import accel_policy
+
+    return accel_policy("POSEIDON_CHAINED")
 
 
 def solve_wave_chained(
