@@ -94,6 +94,56 @@ def extract_band_operands(ecs_b, mt, model) -> dict:
     }
 
 
+def int_surfaces_host(ops, delta_cpu, delta_ram, delta_slots):
+    """Numpy twin of device_cost_build's INTEGER surfaces, given the
+    committed deltas the device measured (they ride the chained solve's
+    stat vector home).  Bit-exact vs the device by construction (same
+    int32 formulas; the parity suite pins it), so the chained path can
+    certify band 2's arc/column capacities WITHOUT fetching two more
+    [E, M] matrices through the tunnel.  Only the float-derived cost
+    matrix still travels."""
+    cpu_req = ops["cpu_req"].astype(np.int64)[:, None]
+    ram_req = ops["ram_req"].astype(np.int64)[:, None]
+    adm0 = ops["adm0"].astype(bool)
+    cpu_committed = ops["cpu_used0"].astype(np.int64) + delta_cpu
+    ram_committed = ops["ram_used0"].astype(np.int64) + delta_ram
+    cpu_free = (ops["cpu_cap"] - cpu_committed)[None, :]
+    ram_free = (ops["ram_cap"] - ram_committed)[None, :]
+    fits = (cpu_req <= cpu_free) & (ram_req <= ram_free)
+    admissible = fits & adm0
+    n_cpu = np.where(
+        cpu_req > 0,
+        np.maximum(cpu_free, 0) // np.maximum(cpu_req, 1), _BIG_FIT,
+    )
+    n_ram = np.where(
+        ram_req > 0,
+        np.maximum(ram_free, 0) // np.maximum(ram_req, 1), _BIG_FIT,
+    )
+    n_fit = np.minimum(np.minimum(n_cpu, n_ram), _BIG_FIT)
+    arc_cap = np.where(admissible, n_fit, 0).astype(np.int32)
+    arc_cap = np.where(
+        ops["anti_self"].astype(bool)[:, None],
+        np.minimum(arc_cap, 1), arc_cap,
+    )
+    capacity = np.maximum(
+        ops["slots_free0"].astype(np.int64) - delta_slots, 0
+    ).astype(np.int32)
+    col_cap = capacity.astype(np.int64)
+    for req, cap_arr, committed in (
+        (ops["cpu_req"], ops["cpu_cap"], cpu_committed),
+        (ops["ram_req"], ops["ram_cap"], ram_committed),
+    ):
+        denom = np.where(admissible, req.astype(np.int64)[:, None], 0)
+        denom = denom.max(axis=0)
+        free = np.maximum(cap_arr.astype(np.int64) - committed, 0)
+        col_cap = np.where(
+            denom > 0,
+            np.minimum(col_cap, free // np.maximum(denom, 1)),
+            col_cap,
+        )
+    return arc_cap, capacity, np.clip(col_cap, 0, None).astype(np.int32)
+
+
 def estimate_costs_host(ops) -> np.ndarray:
     """Numpy estimate of the band's costs at ZERO committed delta.
 

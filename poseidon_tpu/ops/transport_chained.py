@@ -27,9 +27,10 @@ rounds are answered by the host certificate without any dispatch), no
 gang rows (their atomicity repair is an interactive host loop), cpu_mem
 cost model without the net dimension, single-device solver.
 
-Enabled with POSEIDON_CHAINED=1 (default OFF until validated on real
-hardware; pure XLA — no Mosaic risk — but unproven against the live
-tunnel's compiler).
+Gate (chain_gate): the shared accelerator-policy three-state — default
+ON on tpu/axon backends, OFF on CPU (measured wall-clock-neutral
+there), POSEIDON_CHAINED=1/0 forces.  Pure XLA, no Mosaic risk; any
+dispatch failure on an unproven backend declines to the per-band path.
 """
 
 from __future__ import annotations
@@ -88,21 +89,34 @@ def _aggregate_device(costs, capacity, arc_cap, perm, K, B):
     static_argnames=("groups", "block", "max_iter", "scale"),
 )
 def _chained_wave_device(
-    bigA, coarse3A, vecA, reqA, opsB, vecB,
+    bigA, coarse3A, vecA, intB, utilsB, adm0B,
     *, groups, block, max_iter, scale,
 ):
-    """The one-dispatch two-band program.  Operand layout:
+    """The one-dispatch two-band program — SIX packed uploads (each
+    tunnel transfer pays a 60-150 ms latency slot, so operand count is
+    a first-order cost: the naive per-array call shipped ~22):
 
-    - ``bigA`` [2, E1, M2]: band-1 costs + arc capacity;
-    - ``coarse3A`` [3, E1, K]: band-1 host-aggregated coarse instance;
-    - ``vecA``: band-1 packed vector, identical layout to
-      transport_coarse._coarse_fused_device's ``vec``;
-    - ``reqA`` [2, E1]: band-1 per-EC cpu/ram requests (delta matvecs);
-    - ``opsB``: device_cost_build operand dict, padded to [E2, M2]/[M2];
-    - ``vecB``: supplyB | permB | invpermB | unused | eps_sched_coarseB
-      | [eps_capB, mitB, geB, bfmaxB].
-    """
+    - ``bigA`` [2, E1, M2] i32: band-1 costs + arc capacity;
+    - ``coarse3A`` [3, E1, K] i32: band-1 host-aggregated instance;
+    - ``vecA`` i32: the single-band fused layout (supply | capacity |
+      unsched | perm | inv_perm | capg | seed prices | seed fb | coarse
+      eps ladder | [eps_cap, mit, ge, bfmax]) + band-1 cpu reqs +
+      band-1 ram reqs (the delta matvecs);
+    - ``intB`` i32: every band-2 integer operand — cpu_req | ram_req |
+      unsched | anti_self | supply | cpu_cap | ram_cap | cpu_used0 |
+      ram_used0 | cpu_obs0 | ram_obs0 | slots_free0 | permB | invpermB
+      | eps_sched_coarseB | [eps_capB, mitB, geB, bfmaxB];
+    - ``utilsB`` [3, M2] f32: cpu_util | mem_util | (weights in row 2:
+      [0]=measured_weight, [1]=cpu_weight);
+    - ``adm0B`` [E2, M2] int8: selector/pod admissibility mask.
+
+    Returns three buffers: flows [E1+E2, M2] (both bands), the stat
+    vector (incl. the committed DELTAS so the host can rebuild band
+    2's integer surfaces exactly without fetching them), and band 2's
+    float-derived cost matrix (the one surface the host cannot
+    reproduce bit-exactly)."""
     _, E1, M2 = bigA.shape
+    E2 = adm0B.shape[0]  # band-2 padded row count, one source of truth
     K, B = groups, block
     o = 0
     supplyA = vecA[o:o + E1]; o += E1                     # noqa: E702
@@ -118,6 +132,9 @@ def _chained_wave_device(
     mitA = vecA[o + 1]
     geA = vecA[o + 2]
     bfmaxA = vecA[o + 3]
+    o += 4
+    reqA_cpu = vecA[o:o + E1]; o += E1                    # noqa: E702
+    reqA_ram = vecA[o:o + E1]; o += E1                    # noqa: E702
 
     (F1, fb1, prices1, it1, bf1, clean1, pi1,
      itc1, _bfc1, _cc1, _eps1) = coarse_to_fine_band(
@@ -128,23 +145,34 @@ def _chained_wave_device(
     )
 
     # ---- committed deltas, entirely on device (the chain's point).
-    delta_cpu = (F1 * reqA[0][:, None]).sum(axis=0).astype(jnp.int32)
-    delta_ram = (F1 * reqA[1][:, None]).sum(axis=0).astype(jnp.int32)
+    delta_cpu = (F1 * reqA_cpu[:, None]).sum(axis=0).astype(jnp.int32)
+    delta_ram = (F1 * reqA_ram[:, None]).sum(axis=0).astype(jnp.int32)
     delta_slots = F1.sum(axis=0).astype(jnp.int32)
+
+    o = 0
+    opsB = {}
+    for name in ("cpu_req", "ram_req", "unsched", "anti_self"):
+        opsB[name] = intB[o:o + E2]; o += E2              # noqa: E702
+    supplyB = intB[o:o + E2]; o += E2                     # noqa: E702
+    for name in ("cpu_cap", "ram_cap", "cpu_used0", "ram_used0",
+                 "cpu_obs0", "ram_obs0", "slots_free0"):
+        opsB[name] = intB[o:o + M2]; o += M2              # noqa: E702
+    permB = intB[o:o + M2]; o += M2                       # noqa: E702
+    invpermB = intB[o:o + M2]; o += M2                    # noqa: E702
+    epsschedB = intB[o:o + NUM_PHASES]; o += NUM_PHASES   # noqa: E702
+    eps_capB = intB[o]
+    mitB = intB[o + 1]
+    geB = intB[o + 2]
+    bfmaxB = intB[o + 3]
+    opsB["cpu_util"] = utilsB[0]
+    opsB["mem_util"] = utilsB[1]
+    opsB["measured_weight"] = utilsB[2, 0]
+    opsB["cpu_weight"] = utilsB[2, 1]
+    opsB["adm0"] = adm0B
 
     costsB, arcB, _slotsB, colB = device_cost_build(
         opsB, delta_cpu, delta_ram, delta_slots
     )
-    E2 = costsB.shape[0]
-    o = 0
-    supplyB = vecB[o:o + E2]; o += E2                     # noqa: E702
-    permB = vecB[o:o + M2]; o += M2                       # noqa: E702
-    invpermB = vecB[o:o + M2]; o += M2                    # noqa: E702
-    epsschedB = vecB[o:o + NUM_PHASES]; o += NUM_PHASES   # noqa: E702
-    eps_capB = vecB[o]
-    mitB = vecB[o + 1]
-    geB = vecB[o + 2]
-    bfmaxB = vecB[o + 3]
     unschedB = opsB["unsched"]
 
     CgB, capgB, arcgB = _aggregate_device(costsB, colB, arcB, permB, K, B)
@@ -159,9 +187,9 @@ def _chained_wave_device(
         groups=K, block=B, max_iter=max_iter, scale=scale,
     )
 
-    # ---- pack: both flow matrices in ONE fetch, both stat vectors in
-    # another.  costsB rides home with the stats so the host can
-    # certify/commit against the matrix the device actually solved.
+    # ---- pack: both flow matrices in ONE fetch, the stats + deltas in
+    # another; costsB (float-derived, not host-reproducible) rides as
+    # the third and final fetch.
     flows = jnp.concatenate([F1, F2], axis=0)             # [E1+E2, M2]
     small = jnp.concatenate([
         fb1.astype(jnp.int32), prices1.astype(jnp.int32),
@@ -170,8 +198,9 @@ def _chained_wave_device(
         fb2.astype(jnp.int32), prices2.astype(jnp.int32),
         jnp.stack([it2 + itc2, bf2, clean2]).astype(jnp.int32),
         pi2.astype(jnp.int32),
+        delta_cpu, delta_ram, delta_slots,
     ])
-    return flows, small, costsB, arcB, colB
+    return flows, small, costsB
 
 
 def chain_gate() -> bool:
@@ -291,6 +320,7 @@ def solve_wave_chained(
             max(max_cA // 2, 1),
             max(max_iter_total // 2, 1), global_update_every, bf_max,
         ], dtype=np.int32),
+        pad_band_req(req1_cpu, e1_pad), pad_band_req(req1_ram, e1_pad),
     ])
 
     # ---- band 2 padded operands.
@@ -311,7 +341,7 @@ def solve_wave_chained(
         "ram_req": pad_e(ops2["ram_req"]),
         "unsched": pad_e(ops2["unsched"], fill=1),
         "adm0": adm0,
-        "anti_self": pad_e(ops2["anti_self"]),
+        "anti_self": pad_e(ops2["anti_self"].astype(np.int32)),
         "cpu_cap": pad_m(ops2["cpu_cap"]),
         "ram_cap": pad_m(ops2["ram_cap"]),
         "cpu_used0": pad_m(ops2["cpu_used0"]),
@@ -341,33 +371,36 @@ def solve_wave_chained(
     rungs = [eps0]
     for _ in range(NUM_PHASES - 1):
         rungs.append(max(rungs[-1] // LADDER_FACTOR, 1))
-    vecB = np.concatenate([
-        supply2_p, permB, invpermB,
+    intB = np.concatenate([
+        opsB["cpu_req"], opsB["ram_req"], opsB["unsched"],
+        opsB["anti_self"], supply2_p,
+        opsB["cpu_cap"], opsB["ram_cap"], opsB["cpu_used0"],
+        opsB["ram_used0"], opsB["cpu_obs0"], opsB["ram_obs0"],
+        opsB["slots_free0"], permB, invpermB,
         np.asarray(rungs, dtype=np.int32),
         np.asarray([
             eps0, max(max_iter_total // 2, 1), global_update_every,
             bf_max,
         ], dtype=np.int32),
-    ])
+    ]).astype(np.int32)
+    utilsB = np.zeros((3, M2), dtype=np.float32)
+    utilsB[0] = opsB["cpu_util"]
+    utilsB[1] = opsB["mem_util"]
+    utilsB[2, 0] = float(opsB["measured_weight"])
+    utilsB[2, 1] = float(opsB["cpu_weight"])
 
     _Telemetry.device_calls += 1
     try:
-        flows_d, small_d, costsB_d, arcB_d, colB_d = _chained_wave_device(
-            bigA, coarse3A, vecA,
-            np.stack([
-                pad_band_req(req1_cpu, e1_pad),
-                pad_band_req(req1_ram, e1_pad),
-            ]),
-            opsB, vecB,
-            groups=K, block=B, max_iter=max_iter_per_phase, scale=scale,
+        flows_d, small_d, costsB_d = _chained_wave_device(
+            bigA, coarse3A, vecA, intB, utilsB, adm0,
+            groups=K, block=B,
+            max_iter=max_iter_per_phase, scale=scale,
         )
         # Fetch inside the guard: dispatch is async, so execution and
         # transfer errors surface at the first result read.
         small = np.asarray(small_d)
         flows = np.asarray(flows_d)
         costs2 = np.asarray(costsB_d)[:E2, :M]
-        arc2 = np.asarray(arcB_d)[:E2, :M]
-        col2 = np.asarray(colB_d)[:M]
     except Exception as e:  # noqa: BLE001 - decline, never fail the round
         from poseidon_tpu.ops.transport import (
             _is_transient_backend_error,
@@ -392,6 +425,21 @@ def solve_wave_chained(
     fb2 = small[o:o + e2_pad]; o += e2_pad                # noqa: E702
     pr2 = small[o:o + e2_pad + M2 + 1]; o += e2_pad + M2 + 1  # noqa: E702
     it2, bf2, clean2 = small[o], small[o + 1], small[o + 2]; o += 3  # noqa: E702,E501
+    o += NUM_PHASES
+    delta_cpu = small[o:o + M2].astype(np.int64); o += M2  # noqa: E702
+    delta_ram = small[o:o + M2].astype(np.int64); o += M2  # noqa: E702
+    delta_slots = small[o:o + M2].astype(np.int64); o += M2  # noqa: E702
+
+    # Band 2's INTEGER surfaces rebuilt host-side from the measured
+    # deltas — bit-exact vs the device (int_surfaces_host), so they
+    # never travel through the tunnel.
+    from poseidon_tpu.costmodel.device_build import int_surfaces_host
+
+    arc2_full, _slots2, col2_full = int_surfaces_host(
+        opsB, delta_cpu, delta_ram, delta_slots
+    )
+    arc2 = arc2_full[:E2, :M]
+    col2 = col2_full[:M]
 
     def unpack(prices, e_pad, E):
         return np.concatenate([

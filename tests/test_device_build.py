@@ -140,3 +140,31 @@ def test_device_build_unsched_escalator():
     ops = extract_band_operands(ecs, mt, model)
     cm = model.build(ecs, mt)
     np.testing.assert_array_equal(ops["unsched"], cm.unsched_cost)
+
+
+def test_int_surfaces_host_matches_device():
+    """The chained path rebuilds band-2's integer surfaces host-side
+    from fetched deltas (int_surfaces_host) instead of fetching them;
+    they must be BIT-equal to what device_cost_build produced."""
+    from poseidon_tpu.costmodel.device_build import int_surfaces_host
+
+    rng = np.random.default_rng(17)
+    model = CpuMemCostModel()
+    ecs, mt = _tables(rng, 16, 40, obs=True, selectors=True, waits=True)
+    ops = extract_band_operands(ecs, mt, model)
+    ops["anti_self"] = ops["anti_self"].astype(np.int32)
+    delta_cpu = rng.integers(0, 3000, size=40).astype(np.int64)
+    delta_ram = rng.integers(0, 1 << 21, size=40).astype(np.int64)
+    delta_slots = rng.integers(0, 6, size=40).astype(np.int64)
+    _c, arc_d, cap_d, col_d = (
+        np.asarray(x) for x in device_cost_build(
+            ops, delta_cpu.astype(np.int32), delta_ram.astype(np.int32),
+            delta_slots.astype(np.int32),
+        )
+    )
+    arc_h, cap_h, col_h = int_surfaces_host(
+        ops, delta_cpu, delta_ram, delta_slots
+    )
+    np.testing.assert_array_equal(arc_h, arc_d)
+    np.testing.assert_array_equal(cap_h, cap_d)
+    np.testing.assert_array_equal(col_h, col_d)
