@@ -144,39 +144,6 @@ def int_surfaces_host(ops, delta_cpu, delta_ram, delta_slots):
     return arc_cap, capacity, np.clip(col_cap, 0, None).astype(np.int32)
 
 
-def estimate_costs_host(ops) -> np.ndarray:
-    """Numpy estimate of the band's costs at ZERO committed delta.
-
-    Used only for ORDERING (the coarse column sort) and cost-range
-    validation by the chained wave path — the real matrix is built
-    in-program with the actual deltas.  Reuses the extracted operands
-    so the chain pays one admissibility pass, not two (the second full
-    cost_model.build was exactly the host work the chain removes)."""
-    adm0 = ops["adm0"].astype(bool)
-    cpu_req = ops["cpu_req"].astype(np.float64)[:, None]
-    ram_req = ops["ram_req"].astype(np.float64)[:, None]
-    cpu_capf = np.maximum(ops["cpu_cap"].astype(np.float64), 1.0)
-    ram_capf = np.maximum(ops["ram_cap"].astype(np.float64), 1.0)
-    cpu_free = ops["cpu_cap"] - ops["cpu_used0"]
-    ram_free = ops["ram_cap"] - ops["ram_used0"]
-    fits = (cpu_req <= cpu_free[None, :]) & (ram_req <= ram_free[None, :])
-    w = float(ops["measured_weight"])
-    wc = float(ops["cpu_weight"])
-    cpu_load = (
-        (1.0 - w) * (ops["cpu_obs0"][None, :] + cpu_req) / cpu_capf[None, :]
-        + w * ops["cpu_util"].astype(np.float64)[None, :]
-    )
-    mem_load = (
-        (1.0 - w) * (ops["ram_obs0"][None, :] + ram_req) / ram_capf[None, :]
-        + w * ops["mem_util"].astype(np.float64)[None, :]
-    )
-    load = wc * cpu_load + (1.0 - wc) * mem_load
-    costs = np.clip(
-        np.rint(load * base.NORMALIZED_COST), 0, 4 * base.NORMALIZED_COST
-    ).astype(np.int32)
-    return np.where(fits & adm0, costs, INF_COST).astype(np.int32)
-
-
 def device_cost_build(ops, delta_cpu, delta_ram, delta_slots):
     """jnp cost build for one band given earlier bands' committed deltas.
 

@@ -1093,18 +1093,16 @@ class RoundPlanner:
             ecs_1, cm1, mt, committed_cpu, committed_ram, committed_net
         )
         from poseidon_tpu.costmodel.device_build import (
-            estimate_costs_host,
             extract_band_operands,
         )
 
         ops2 = extract_band_operands(ecs_2, mt_b, self.cost_model)
-        est2 = estimate_costs_host(ops2)
         out = solve_wave_chained(
             cm1.costs, ecs_1.supply, col1, cm1.unsched_cost,
             cm1.arc_capacity,
             ecs_1.cpu_request.astype(np.int32),
             ecs_1.ram_request.astype(np.int32),
-            ops2, ecs_2.supply, est2,
+            ops2, ecs_2.supply,
             max_cost_hint=self.cost_model.max_cost(),
             global_update_every=self.global_update_every,
         )
