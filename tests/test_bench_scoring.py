@@ -71,3 +71,29 @@ def test_single_config_mode_scores_requested_config():
     assert out["value"] == 0.2
     assert out["vs_baseline"] == 5.0
     assert out["target_machines"] == 200
+
+
+def test_last_live_tpu_loader(tmp_path, monkeypatch):
+    """The evidence loader returns the newest COMPLETED live-TPU rung at
+    the target config, skipping corrupt lines and later partial
+    captures, and never raises."""
+    import json as _json
+
+    import bench
+
+    out = tmp_path / "out"
+    out.mkdir()
+    monkeypatch.setattr(
+        bench.os.path, "dirname", lambda p: str(tmp_path)
+    )
+    rung = {"machines": 10, "tasks": 100, "backend": "tpu", "ok": True,
+            "wave_p50_s": 1.5}
+    lines = [
+        _json.dumps({"ladder": [rung]}),
+        "{not json",
+        _json.dumps({"ladder": []}),  # later partial capture
+    ]
+    (out / "tpu_bench.jsonl").write_text("\n".join(lines))
+    got = bench._load_last_live_tpu((10, 100))
+    assert got is not None and got["wave_p50_s"] == 1.5
+    assert bench._load_last_live_tpu((99, 999)) is None

@@ -90,3 +90,24 @@ def test_chained_warm_frames_route_next_round(monkeypatch):
     # Quiet round: nothing changed.
     _, m2 = planner.schedule_round()
     assert m2.iterations == 0
+
+
+def test_chained_dispatch_failure_declines(monkeypatch):
+    """A backend failure inside the chained dispatch (tunnel flake,
+    remote-compile restart) must DECLINE to the per-band path — never
+    fail the scheduling round."""
+    import poseidon_tpu.ops.transport_chained as TC
+
+    monkeypatch.setenv("POSEIDON_CHAINED", "1")
+    monkeypatch.setenv("POSEIDON_HOST_CERT", "0")
+
+    def boom(*a, **k):
+        raise RuntimeError("UNAVAILABLE: remote_compile: Connection refused")
+
+    monkeypatch.setattr(TC, "_chained_wave_device", boom)
+    st = _mixed_state()
+    planner = RoundPlanner(st, CpuMemCostModel())
+    deltas, m = planner.schedule_round()
+    # The per-band path completed the round.
+    assert m.converged and m.placed == 520
+    assert m.device_calls >= 3  # chained counter + per-band dispatches
