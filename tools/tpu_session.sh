@@ -34,19 +34,29 @@ EOF
   export PYTHONPATH
   export POSEIDON_BENCH_FUSED_SMOKE=1
   PROFILE_ARGS="--machines 200 --ecs 32"
+  WAVE_ARGS="--machines 200 --tasks 2000 --waves 2 --churn 2"
+  TRANSFER_ARGS="--reps 2"
   BENCH_ARGS="--machines 200 --tasks 2000 --rounds 2"
 else
   PROFILE_ARGS="--machines 1000 --ecs 100"
+  WAVE_ARGS="--machines 10000 --tasks 100000 --waves 4 --churn 3"
+  TRANSFER_ARGS=""
   BENCH_ARGS="--verbose"
 fi
 
 echo "=== 1. latency decomposition (tunnel dispatch / transfer / solve)"
 python tools/profile_solver.py $PROFILE_ARGS 2>&1 | tee "out/tpu_profile_1k.txt$SUFFIX"
 
-echo "=== 2. fused-kernel Mosaic validation + A/B vs lax path"
+echo "=== 2. transfer scaling (latency vs bandwidth fit)"
+python tools/profile_transfer.py $TRANSFER_ARGS 2>&1 | tee "out/tpu_transfer.txt$SUFFIX"
+
+echo "=== 3. fused-kernel Mosaic validation + A/B vs lax path"
 python tools/bench_fused.py 2>&1 | tee "out/tpu_fused_ab.txt$SUFFIX"
 
-echo "=== 3. full bench ladder (tagged backend; partial lines salvage)"
+echo "=== 4. wave/churn stage split at the north star (chained path live)"
+python tools/profile_wave.py $WAVE_ARGS 2>&1 | tee "out/tpu_wave_stages.txt$SUFFIX"
+
+echo "=== 5. full bench ladder (tagged backend; partial lines salvage)"
 POSEIDON_BENCH_RUNG_TIMEOUT="${POSEIDON_BENCH_RUNG_TIMEOUT:-3000}" \
 python bench.py $BENCH_ARGS 2> >(tee "out/tpu_bench_stderr.txt$SUFFIX" >&2) | tee "out/tpu_bench.jsonl$SUFFIX"
 
