@@ -664,8 +664,29 @@ class RoundPlanner:
             else:
                 deferred.append(work)
 
+        def on_band_reset():
+            # A speculative chunk (the chained path's early band-1
+            # assignment) whose round DECLINED must be discarded before
+            # the per-band path re-assigns the same ECs — duplicate
+            # chunks would double every delta.  Metrics counted by the
+            # discarded chunk are rolled back by re-zeroing the fields
+            # _assign_ecs accumulates.
+            for f in futures:
+                try:
+                    f.result()
+                except Exception:  # noqa: BLE001
+                    pass
+            futures.clear()
+            deferred.clear()
+            chunks.clear()
+            metrics.placed = metrics.preempted = metrics.migrated = 0
+            metrics.unscheduled = 0
+
         try:
-            flows = self._solve_banded(ecs, mt, metrics, on_band=on_band)
+            flows = self._solve_banded(
+                ecs, mt, metrics, on_band=on_band,
+                on_band_reset=on_band_reset,
+            )
         except BaseException:
             # A failed solve must not leave an orphaned worker chunk
             # mutating shared state (prior_machine hints) for a round
@@ -706,9 +727,10 @@ class RoundPlanner:
                 deltas = []
                 placements: list = []
                 for k in sorted(chunks):
-                    d, p = chunks[k]
+                    d, p, hints = chunks[k]
                     deltas.extend(d)
                     placements.extend(p)
+                    self._apply_hint_reinserts(hints)
                 st.apply_placements(placements)
             else:
                 # Degenerate paths that skipped every band (M == 0).
@@ -902,7 +924,8 @@ class RoundPlanner:
             n += 1
         return n, np.sort(idx)
 
-    def _solve_banded(self, ecs, mt, metrics, on_band=None) -> np.ndarray:
+    def _solve_banded(self, ecs, mt, metrics, on_band=None,
+                      on_band_reset=None) -> np.ndarray:
         """The round's solve: size-banded transportation with committed
         resources flowing between bands.
 
@@ -951,6 +974,7 @@ class RoundPlanner:
             chained = self._try_chained_wave(
                 ecs, mt, bands, remaining, committed_cpu, committed_ram,
                 committed_net, base_slots, flows_full, metrics, on_band,
+                on_band_reset,
             )
             if chained is not None:
                 return chained
@@ -1000,7 +1024,7 @@ class RoundPlanner:
 
     def _try_chained_wave(self, ecs, mt, bands, remaining, committed_cpu,
                           committed_ram, committed_net, base_slots,
-                          flows_full, metrics, on_band):
+                          flows_full, metrics, on_band, on_band_reset):
         """Single-dispatch two-band wave (ops/transport_chained), or
         None to fall through to the per-band loop.
 
@@ -1097,6 +1121,21 @@ class RoundPlanner:
         )
 
         ops2 = extract_band_operands(ecs_2, mt_b, self.cost_model)
+        fired = []
+
+        def early(flows1):
+            # Band 1's flows are final the moment they land: start its
+            # assignment on the worker thread while the main thread
+            # still fetches band 2's cost matrix and certifies both
+            # bands (the per-band path's pipelining, kept under the
+            # single-dispatch chain).  A later decline discards the
+            # speculative chunk via on_band_reset.
+            if on_band is None:
+                return
+            flows_full[idx1] = flows1
+            fired.append(True)
+            on_band(idx1, False, flows_full)
+
         out = solve_wave_chained(
             cm1.costs, ecs_1.supply, col1, cm1.unsched_cost,
             cm1.arc_capacity,
@@ -1105,8 +1144,11 @@ class RoundPlanner:
             ops2, ecs_2.supply,
             max_cost_hint=self.cost_model.max_cost(),
             global_update_every=self.global_update_every,
+            early=early,
         )
         if out is None:
+            if fired and on_band_reset is not None:
+                on_band_reset()
             return None
         sol1, sol2, costs2 = out
         flows_full[idx1] = sol1.flows
@@ -1130,7 +1172,8 @@ class RoundPlanner:
                     unsched_cost=unsched_b.astype(np.int64),
                 )
         if on_band is not None:
-            on_band(idx1, False, flows_full)
+            if not fired:
+                on_band(idx1, False, flows_full)
             on_band(idx2, True, flows_full)
         return flows_full
 
@@ -1419,9 +1462,10 @@ class RoundPlanner:
            (bounded unfairness), machine columns in ascending order;
         3. diffs against the previous placement become the deltas.
         """
-        deltas, placements = self._assign_ecs(
+        deltas, placements, hints = self._assign_ecs(
             range(view.ecs.num_ecs), flows, view, metrics
         )
+        self._apply_hint_reinserts(hints)
         self.state.apply_placements(placements)
         return deltas
 
@@ -1446,6 +1490,7 @@ class RoundPlanner:
         M = mt.num_machines
         uuids = mt.uuids
         placements: List[Tuple[int, Optional[str]]] = []
+        hint_reinserts: List[Tuple[int, str]] = []
 
         for i in ec_indices:
             uids = view.member_uids[i]
@@ -1507,18 +1552,16 @@ class RoundPlanner:
                 # no flow) go back into the state dict: one-shot consume
                 # is only for hints actually used.  Members placed
                 # elsewhere drop theirs — the new machine supersedes it
-                # on the next removal.
+                # on the next removal.  COLLECTED here, applied at the
+                # commit point with the placements: a speculative chunk
+                # (the chained wave's early assignment) whose round
+                # declines must leave no trace in shared hint state.
                 pcols = self._round_prior[i]
                 unapplied = np.nonzero((pcols >= 0) & (new_col < 0))[0]
-                if unapplied.size:
-                    with self.state._lock:
-                        pm = self.state.prior_machine
-                        for j in unapplied.tolist():
-                            uid = int(uids[j])
-                            pm.pop(uid, None)  # refresh FIFO position
-                            pm[uid] = uuids[int(pcols[j])]
-                        while len(pm) > self.state._PRIOR_CAP:
-                            pm.pop(next(iter(pm)))
+                for j in unapplied.tolist():
+                    hint_reinserts.append(
+                        (int(uids[j]), uuids[int(pcols[j])])
+                    )
 
             # Pass 3: diff -> deltas; only changed tasks touch Python.
             if not self.preemption:
@@ -1560,4 +1603,18 @@ class RoundPlanner:
             still = np.nonzero((new_col < 0) & (cur < 0))[0]
             placements.extend((u, None) for u in uids[still].tolist())
 
-        return deltas, placements
+        return deltas, placements, hint_reinserts
+
+    def _apply_hint_reinserts(self, hint_reinserts) -> None:
+        """Commit-time application of the unapplied-hint re-inserts a
+        chunk collected (FIFO refresh + cap eviction, under the state
+        lock) — runs only for chunks whose round actually commits."""
+        if not hint_reinserts:
+            return
+        with self.state._lock:
+            pm = self.state.prior_machine
+            for uid, machine in hint_reinserts:
+                pm.pop(uid, None)  # refresh FIFO position
+                pm[uid] = machine
+            while len(pm) > self.state._PRIOR_CAP:
+                pm.pop(next(iter(pm)))

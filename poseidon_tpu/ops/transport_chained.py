@@ -234,6 +234,7 @@ def solve_wave_chained(
     max_iter_total: int = 8192,
     global_update_every: int = 4,
     bf_max: int = 64,
+    early=None,
 ) -> Optional[Tuple[TransportSolution, TransportSolution, np.ndarray]]:
     """Host wrapper: pack, dispatch once, certify both bands.
 
@@ -405,19 +406,7 @@ def solve_wave_chained(
     utilsB[2, 0] = float(opsB["measured_weight"])
     utilsB[2, 1] = float(opsB["cpu_weight"])
 
-    _Telemetry.device_calls += 1
-    try:
-        flows_d, small_d, costsB_d = _chained_wave_device(
-            bigA, coarse3A, vecA, intB, utilsB, adm0,
-            groups=K, block=B,
-            max_iter=max_iter_per_phase, scale=scale,
-        )
-        # Fetch inside the guard: dispatch is async, so execution and
-        # transfer errors surface at the first result read.
-        small = np.asarray(small_d)
-        flows = np.asarray(flows_d)
-        costs2 = np.asarray(costsB_d)[:E2, :M]
-    except Exception as e:  # noqa: BLE001 - decline, never fail the round
+    def _decline_on_backend_error(e) -> None:
         from poseidon_tpu.ops.transport import (
             _is_transient_backend_error,
         )
@@ -429,6 +418,40 @@ def solve_wave_chained(
             "" if _is_transient_backend_error(e) else
             " (non-transient - investigate)",
         )
+
+    _Telemetry.device_calls += 1
+    try:
+        flows_d, small_d, costsB_d = _chained_wave_device(
+            bigA, coarse3A, vecA, intB, utilsB, adm0,
+            groups=K, block=B,
+            max_iter=max_iter_per_phase, scale=scale,
+        )
+        # Fetch inside the guard: dispatch is async, so execution and
+        # transfer errors surface at the first result read.  Start all
+        # three transfers concurrently — each serialized fetch is a
+        # tunnel latency slot.
+        try:
+            flows_d.copy_to_host_async()
+            costsB_d.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        small = np.asarray(small_d)
+        flows = np.asarray(flows_d)
+    except Exception as e:  # noqa: BLE001 - decline, never fail the round
+        _decline_on_backend_error(e)
+        return None
+    if early is not None:
+        # OUTSIDE the backend guard: flows is a host array here, so an
+        # exception from the caller's callback is a caller bug and must
+        # propagate, not be misreported as a backend decline.  Band 1's
+        # flows are final — the caller's assignment work overlaps the
+        # costs2 fetch and the finalize passes below; a later decline
+        # makes the caller discard it (on_band_reset).
+        early(flows[:E1, :M])
+    try:
+        costs2 = np.asarray(costsB_d)[:E2, :M]
+    except Exception as e:  # noqa: BLE001 - transfer flake: decline
+        _decline_on_backend_error(e)
         return None
 
     # ---- unpack band stats and certify each band host-side (the same

@@ -111,3 +111,30 @@ def test_chained_dispatch_failure_declines(monkeypatch):
     # The per-band path completed the round.
     assert m.converged and m.placed == 520
     assert m.device_calls >= 3  # chained counter + per-band dispatches
+
+
+def test_chained_late_decline_discards_speculative_assignment(monkeypatch):
+    """A decline AFTER the early band-1 assignment fired (non-converged
+    band, failed costs2 fetch) must discard the speculative chunk: the
+    per-band re-solve owns the round, with no duplicated deltas or
+    double-counted metrics."""
+    import poseidon_tpu.ops.transport_chained as TC
+
+    monkeypatch.setenv("POSEIDON_CHAINED", "1")
+    monkeypatch.setenv("POSEIDON_HOST_CERT", "0")
+
+    def fake_solve(costs1, supply1, col_cap1, unsched1, arc1, rc, rr,
+                   ops2, supply2, *, early=None, **kw):
+        if early is not None:
+            early(np.zeros_like(costs1))  # speculative, then decline
+        return None
+
+    monkeypatch.setattr(TC, "solve_wave_chained", fake_solve)
+    st = _mixed_state()
+    planner = RoundPlanner(st, CpuMemCostModel())
+    deltas, m = planner.schedule_round()
+    assert m.converged
+    assert m.placed == 520  # not 520 + the discarded chunk's count
+    placed_uids = [d.task_id for d in deltas
+                   if d.type == d.type.__class__.PLACE]
+    assert len(placed_uids) == len(set(placed_uids)) == 520
