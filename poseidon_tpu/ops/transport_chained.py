@@ -27,10 +27,10 @@ rounds are answered by the host certificate without any dispatch), no
 gang rows (their atomicity repair is an interactive host loop), cpu_mem
 cost model without the net dimension, single-device solver.
 
-Gate (chain_gate): the shared accelerator-policy three-state — default
-ON on tpu/axon backends, OFF on CPU (measured wall-clock-neutral
-there), POSEIDON_CHAINED=1/0 forces.  Pure XLA, no Mosaic risk; any
-dispatch failure on an unproven backend declines to the per-band path.
+Gate (chain_gate): opt-in via POSEIDON_CHAINED=1, default OFF pending
+the live A/B (see chain_gate's docstring for the measured CPU trade).
+Pure XLA, no Mosaic risk; any dispatch failure declines to the
+per-band path.
 """
 
 from __future__ import annotations
@@ -205,17 +205,21 @@ def _chained_wave_device(
 
 
 def chain_gate() -> bool:
-    """Accelerator-default policy gate (POSEIDON_CHAINED=1/0 forces).
+    """Opt-in gate: POSEIDON_CHAINED=1 enables the chained wave.
 
-    Default ON for accelerator backends: the chain's win is the
-    tunnel's per-transfer latency and the inter-band host rebuild; on
-    CPU it is wall-clock-neutral (measured at 10k/100k), so the plain
-    per-band path stays the CPU default.  Any dispatch failure on an
-    unproven backend declines to the per-band path (the guard in
-    solve_wave_chained), so the accel default is fail-safe."""
-    from poseidon_tpu.ops.transport import accel_policy
+    Default OFF everywhere, pending a LIVE A/B: on CPU the chain
+    measured ~1.5-2 s/wave SLOWER at 10k/100k (band 2's in-program
+    coarse stage starts cold — no host greedy seed — and its epsilon
+    ladder derives from the conservative model bound, so it pays extra
+    iterations the per-band path's host machinery avoids).  On the
+    tunnel those extra device iterations trade against ~4 transfer
+    slots + the 0.25 s inter-band host rebuild — plausibly a win, but
+    unproven, and the scored artifact must not gamble on it.
+    tools/tpu_session.sh A/Bs both paths live; flip the default only
+    with hardware evidence."""
+    import os
 
-    return accel_policy("POSEIDON_CHAINED")
+    return os.environ.get("POSEIDON_CHAINED") == "1"
 
 
 def solve_wave_chained(

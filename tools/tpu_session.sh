@@ -53,8 +53,11 @@ python tools/profile_transfer.py $TRANSFER_ARGS 2>&1 | tee "out/tpu_transfer.txt
 echo "=== 3. fused-kernel Mosaic validation + A/B vs lax path"
 python tools/bench_fused.py 2>&1 | tee "out/tpu_fused_ab.txt$SUFFIX"
 
-echo "=== 4. wave/churn stage split at the north star (chained path live)"
+echo "=== 4. wave/churn stage split at the north star (per-band path)"
 python tools/profile_wave.py $WAVE_ARGS 2>&1 | tee "out/tpu_wave_stages.txt$SUFFIX"
+
+echo "=== 4b. same, CHAINED single-dispatch wave (the live A/B that decides its default)"
+POSEIDON_CHAINED=1 python tools/profile_wave.py $WAVE_ARGS 2>&1 | tee "out/tpu_wave_chained.txt$SUFFIX"
 
 echo "=== 5. full bench ladder (tagged backend; partial lines salvage)"
 POSEIDON_BENCH_RUNG_TIMEOUT="${POSEIDON_BENCH_RUNG_TIMEOUT:-3000}" \
