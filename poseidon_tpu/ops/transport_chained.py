@@ -177,6 +177,21 @@ def _chained_wave_device(
     unschedB = opsB["unsched"]
 
     CgB, capgB, arcgB = _aggregate_device(costsB, colB, arcB, permB, K, B)
+    # Epsilon ladders from the ACTUAL device-built costs, not the
+    # conservative model bound the host shipped: the hint-based ladder
+    # starts ~2x too high and measured ~1.5-2 s/wave of extra sweeps on
+    # CPU at 10k/100k.  Same derivation as the in-program full ladder
+    # (eps0 = max finite cost * scale / 2, LADDER_FACTOR divides).
+    finiteB = jnp.where(costsB < INF_COST, costsB, 0)
+    max_cB = jnp.maximum(
+        jnp.maximum(finiteB.max(), unschedB.max()), 1
+    ) * scale
+    eps0B = jnp.minimum(jnp.maximum(max_cB // 2, 1), epsschedB[0])
+    rungsB = [eps0B]
+    for _ in range(NUM_PHASES - 1):
+        rungsB.append(jnp.maximum(rungsB[-1] // LADDER_FACTOR, 1))
+    eps_sched_actB = jnp.stack(rungsB).astype(jnp.int32)
+    eps_capB = jnp.minimum(eps_capB, jnp.maximum(max_cB // 2, 1))
     zeros_p = jnp.zeros(E2 + K + 1, dtype=jnp.int32)
     zeros_f = jnp.zeros((E2, K), dtype=jnp.int32)
     zeros_fb = jnp.zeros(E2, dtype=jnp.int32)
@@ -184,7 +199,7 @@ def _chained_wave_device(
      itc2, _bfc2, _cc2, _eps2) = coarse_to_fine_band(
         costsB, arcB, colB, supplyB, unschedB, permB, invpermB,
         CgB, capgB, arcgB, zeros_f, zeros_p, zeros_fb,
-        epsschedB, eps_capB, mitB, geB, bfmaxB,
+        eps_sched_actB, eps_capB, mitB, geB, bfmaxB,
         groups=K, block=B, max_iter=max_iter, scale=scale,
     )
 
