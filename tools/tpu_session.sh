@@ -59,6 +59,11 @@ python tools/profile_wave.py $WAVE_ARGS 2>&1 | tee "out/tpu_wave_stages.txt$SUFF
 echo "=== 4b. same, CHAINED single-dispatch wave (the live A/B that decides its default)"
 POSEIDON_CHAINED=1 python tools/profile_wave.py $WAVE_ARGS 2>&1 | tee "out/tpu_wave_chained.txt$SUFFIX"
 
+echo "=== 4c. same, host-seeded per-band path (fused pipeline OFF): the fused"
+echo "===     pipeline pays 3-4x the iterations for 2 fewer dispatches - at the"
+echo "===     measured ~1.5ms/iter this arm decides whether it stays accel-default"
+POSEIDON_COARSE_FUSED=0 python tools/profile_wave.py $WAVE_ARGS 2>&1 | tee "out/tpu_wave_hostseed.txt$SUFFIX"
+
 echo "=== 5. full bench ladder (tagged backend; partial lines salvage)"
 POSEIDON_BENCH_RUNG_TIMEOUT="${POSEIDON_BENCH_RUNG_TIMEOUT:-3000}" \
 python bench.py $BENCH_ARGS 2> >(tee "out/tpu_bench_stderr.txt$SUFFIX" >&2) | tee "out/tpu_bench.jsonl$SUFFIX"
