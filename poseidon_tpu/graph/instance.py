@@ -969,6 +969,8 @@ class RoundPlanner:
         objective = 0
         gap = 0.0
         iters = 0
+        self._hidden_iters = 0
+        self._hidden_bf = 0
         remaining = sorted(set(bands.tolist()))
         if len(remaining) > 1:
             chained = self._try_chained_wave(
@@ -1019,7 +1021,8 @@ class RoundPlanner:
 
         metrics.objective = objective
         metrics.gap_bound = gap
-        metrics.iterations = iters
+        metrics.iterations = iters + self._hidden_iters
+        metrics.bf_sweeps += self._hidden_bf
         return flows_full
 
     def _try_chained_wave(self, ecs, mt, bands, remaining, committed_cpu,
@@ -1255,9 +1258,22 @@ class RoundPlanner:
                         pre=pre,
                     )
                 if sol is None:
+                    def counting_solve(*a, **k):
+                        # The coarse dispatch's iterations/sweeps must
+                        # land in the round metrics: leaving them out
+                        # made the host two-dispatch path look 3-4x
+                        # iteration-cheaper than the fused pipeline
+                        # (which reports coarse+full) when the true
+                        # work is comparable — an accounting artifact
+                        # that nearly mis-decided the fused default.
+                        s = self._dispatch_solve(*a, **k)
+                        self._hidden_iters += s.iterations
+                        self._hidden_bf += s.bf_sweeps
+                        return s
+
                     cs = coarse_warm_start(
                         cm.costs, ecs_b.supply, col_cap, cm.unsched_cost,
-                        cm.arc_capacity, self._dispatch_solve,
+                        cm.arc_capacity, counting_solve,
                         max_cost_hint=hint, pre=pre,
                     )
                     if cs is not None:
