@@ -40,12 +40,14 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 
 from poseidon_tpu.costmodel.device_build import device_cost_build
 from poseidon_tpu.ops.transport import (
     COST_CAP,
     INF_COST,
+    PRICE_SPREAD_CAP,
     LADDER_FACTOR,
     NUM_PHASES,
     UNBOUNDED_ARC_CAP,
@@ -56,7 +58,10 @@ from poseidon_tpu.ops.transport import (
     coarse_sort_order,
     padded_shape,
 )
-from poseidon_tpu.ops.transport_coarse import coarse_to_fine_band
+from poseidon_tpu.ops.transport_coarse import (
+    _certified_eps_device,
+    coarse_to_fine_band,
+)
 
 _AGG_LIM_BASE = 1 << 29
 
@@ -85,6 +90,84 @@ def _aggregate_device(costs, capacity, arc_cap, perm, K, B):
     return Cg, capg, arcg
 
 
+def _greedy_seed_device(C, supply, capacity, arc_cap, unsched, scale,
+                        max_raw_q):
+    """In-program twin of transport.maybe_greedy_start for the chained
+    band-2 COARSE stage: cheapest-first greedy flows (a row scan
+    carrying remaining column capacity) + two alternation sweeps of
+    equilibrium duals + the exact epsilon certificate, with the same
+    usefulness gate.  Runs at [E, K] (K = coarse groups), so it costs a
+    few hundred VPU ops — the host twin's absence made band 2's coarse
+    stage start cold at 2-3x the iterations.
+
+    Returns ``(F0, fb0, prices, eps0, usable)``; ``usable`` False means
+    the caller starts the cold ladder (zeros + its own eps schedule),
+    exactly as the host gate does.  Semantics-, not bit-, identical to
+    the host (argsort tie order may differ); correctness stays
+    certificate-gated downstream.
+    """
+    E, K = C.shape
+    adm = C < INF_COST
+    order = jnp.argsort(jnp.where(adm, C, INF_COST), axis=1, stable=True)
+    inv = jnp.argsort(order, axis=1, stable=True)
+
+    def row(cap_left, inputs):
+        want, arc_row, adm_row, ord_row, inv_row = inputs
+        caps = jnp.where(adm_row, jnp.minimum(cap_left, arc_row), 0)
+        caps_o = jnp.take(caps, ord_row)
+        before = jnp.cumsum(caps_o) - caps_o
+        take_o = jnp.clip(jnp.minimum(caps_o, want - before), 0, None)
+        take = jnp.take(take_o, inv_row)
+        return cap_left - take, take
+
+    _, F0 = lax.scan(
+        row, capacity.astype(jnp.int32), (supply, arc_cap, adm, order, inv)
+    )
+    F0 = F0.astype(jnp.int32)
+    leftover = supply - F0.sum(axis=1)
+    fb0 = leftover.astype(jnp.int32)
+
+    # Equilibrium duals (the host alternation, int32: scaled costs and
+    # spread-capped prices both fit well inside 2^30).
+    BIG = jnp.int32(1 << 30)
+    used = F0 > 0
+    C32 = C.astype(jnp.int32)
+    marginal = jnp.where(used, C32, -1).max(axis=1)
+    marginal = jnp.where(leftover > 0, unsched, marginal)
+    marginal = jnp.clip(marginal, 0, None)
+    Uem = jnp.minimum(supply[:, None], capacity[None, :])
+    Uem = jnp.minimum(Uem, arc_cap)
+    resid = adm & (Uem - F0 > 0)
+    Cs = jnp.where(adm, C32 * scale, BIG)
+    has_flow = used.any(axis=1)
+    pe0 = -scale * marginal
+    pm0 = jnp.zeros(K, dtype=jnp.int32)
+    for _ in range(2):
+        q = Cs + pe0[:, None]
+        lo = jnp.where(used, q, -BIG).max(axis=0)
+        hi = jnp.where(resid, q, BIG).min(axis=0)
+        pm0 = jnp.maximum(lo, jnp.minimum(hi, 0))
+        net = jnp.where(used, Cs - pm0[None, :], BIG).min(axis=1)
+        pe0 = jnp.where(has_flow, -net, -scale * marginal)
+    cap_p = PRICE_SPREAD_CAP - 1
+    pm0 = jnp.clip(pm0, -cap_p, cap_p)
+    pe0 = jnp.clip(pe0, -cap_p, cap_p)
+    spare = F0.sum(axis=0) < capacity
+    pt0 = jnp.where(spare, pm0, BIG).min()
+    pt0 = jnp.where(pt0 == BIG, 0, jnp.minimum(pt0, 0))
+    prices = jnp.concatenate(
+        [pe0, pm0, pt0[None]]
+    ).astype(jnp.int32)
+
+    eps_g = _certified_eps_device(
+        F0, fb0, prices, C=Cs.astype(jnp.int32),
+        U=(unsched * scale).astype(jnp.int32), Uem=Uem,
+        capacity=capacity, supply=supply, E=E, M=K,
+    )
+    usable = eps_g <= jnp.maximum(scale, max_raw_q * scale // 4)
+    return F0, fb0, prices, eps_g, usable
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("groups", "block", "max_iter", "scale"),
@@ -106,7 +189,7 @@ def _chained_wave_device(
     - ``intB`` i32: every band-2 integer operand — cpu_req | ram_req |
       unsched | anti_self | supply | cpu_cap | ram_cap | cpu_used0 |
       ram_used0 | cpu_obs0 | ram_obs0 | slots_free0 | permB | invpermB
-      | eps_sched_coarseB | [eps_capB, mitB, geB, bfmaxB];
+      | eps_sched_coarseB | [eps_capB, mitB, geB, bfmaxB, max_raw_qB];
     - ``utilsB`` [3, M2] f32: cpu_util | mem_util | (weights in row 2:
       [0]=measured_weight, [1]=cpu_weight);
     - ``adm0B`` [E2, M2] int8: selector/pod admissibility mask.
@@ -165,6 +248,7 @@ def _chained_wave_device(
     mitB = intB[o + 1]
     geB = intB[o + 2]
     bfmaxB = intB[o + 3]
+    max_raw_qB = intB[o + 4]
     opsB["cpu_util"] = utilsB[0]
     opsB["mem_util"] = utilsB[1]
     opsB["measured_weight"] = utilsB[2, 0]
@@ -178,28 +262,39 @@ def _chained_wave_device(
 
     CgB, capgB, arcgB = _aggregate_device(costsB, colB, arcB, permB, K, B)
     # Epsilon ladders from the ACTUAL device-built costs, not the
-    # conservative model bound the host shipped: the hint-based ladder
-    # starts ~2x too high and measured ~1.5-2 s/wave of extra sweeps on
-    # CPU at 10k/100k.  Same derivation as the in-program full ladder
-    # (eps0 = max finite cost * scale / 2, LADDER_FACTOR divides).
+    # conservative model bound the host shipped (the hint-based ladder
+    # starts ~2x too high), and a GREEDY+DUAL seed for the coarse stage
+    # — the in-program twin of the host seed whose absence made band
+    # 2's coarse stage start cold at 2-3x the iterations.
     finiteB = jnp.where(costsB < INF_COST, costsB, 0)
     max_cB = jnp.maximum(
         jnp.maximum(finiteB.max(), unschedB.max()), 1
     ) * scale
-    eps0B = jnp.minimum(jnp.maximum(max_cB // 2, 1), epsschedB[0])
-    rungsB = [eps0B]
+    eps_capB = jnp.minimum(eps_capB, jnp.maximum(max_cB // 2, 1))
+    gF, gfb, gp, geps, usable = _greedy_seed_device(
+        CgB, supplyB, capgB, arcgB, unschedB, scale, max_raw_qB
+    )
+    # Gate declines drop only the PRICES (cold ladder): the greedy
+    # FLOWS keep their measured warm-start value either way — same
+    # policy as the host fused path's gp_c-None branch.
+    seed_f = gF.astype(jnp.int32)
+    seed_fb = gfb.astype(jnp.int32)
+    seed_p = jnp.where(usable, gp, 0).astype(jnp.int32)
+    finiteCg = jnp.where(CgB < INF_COST, CgB, 0)
+    cold0 = jnp.maximum(
+        jnp.maximum(finiteCg.max(), unschedB.max()), 1
+    ) * scale // 2
+    eps0c = jnp.where(usable, geps, jnp.maximum(cold0, 1))
+    eps0c = jnp.minimum(eps0c, epsschedB[0])
+    rungsB = [jnp.maximum(eps0c, 1)]
     for _ in range(NUM_PHASES - 1):
         rungsB.append(jnp.maximum(rungsB[-1] // LADDER_FACTOR, 1))
-    eps_sched_actB = jnp.stack(rungsB).astype(jnp.int32)
-    eps_capB = jnp.minimum(eps_capB, jnp.maximum(max_cB // 2, 1))
-    zeros_p = jnp.zeros(E2 + K + 1, dtype=jnp.int32)
-    zeros_f = jnp.zeros((E2, K), dtype=jnp.int32)
-    zeros_fb = jnp.zeros(E2, dtype=jnp.int32)
+    eps_sched_cB = jnp.stack(rungsB).astype(jnp.int32)
     (F2, fb2, prices2, it2, bf2, clean2, pi2,
      itc2, _bfc2, _cc2, _eps2) = coarse_to_fine_band(
         costsB, arcB, colB, supplyB, unschedB, permB, invpermB,
-        CgB, capgB, arcgB, zeros_f, zeros_p, zeros_fb,
-        eps_sched_actB, eps_capB, mitB, geB, bfmaxB,
+        CgB, capgB, arcgB, seed_f, seed_p, seed_fb,
+        eps_sched_cB, eps_capB, mitB, geB, bfmaxB,
         groups=K, block=B, max_iter=max_iter, scale=scale,
     )
 
@@ -222,16 +317,16 @@ def _chained_wave_device(
 def chain_gate() -> bool:
     """Opt-in gate: POSEIDON_CHAINED=1 enables the chained wave.
 
-    Default OFF everywhere, pending a LIVE A/B: on CPU the chain
-    measured ~1.5-2 s/wave SLOWER at 10k/100k (band 2's in-program
-    coarse stage starts cold — no host greedy seed — and its epsilon
-    ladder derives from the conservative model bound, so it pays extra
-    iterations the per-band path's host machinery avoids).  On the
-    tunnel those extra device iterations trade against ~4 transfer
-    slots + the 0.25 s inter-band host rebuild — plausibly a win, but
-    unproven, and the scored artifact must not gamble on it.
-    tools/tpu_session.sh A/Bs both paths live; flip the default only
-    with hardware evidence."""
+    Default OFF everywhere, pending a LIVE A/B.  With the in-program
+    greedy+dual seed and actual-cost epsilon ladders landed, the
+    chain's iteration count is within ~1.2-1.6x of the (honestly
+    counted) per-band path, but the CPU wall gap remains ~6.3-7.6 s vs
+    ~4.2-5.0 s at 10k/100k — the residual is one-program XLA CPU
+    scheduling, which a host cannot price for the tunnel.  On the
+    tunnel the chain saves ~4 transfer slots + the 0.25 s inter-band
+    host rebuild against that residual; tools/tpu_session.sh step 4b
+    A/Bs both paths live, and the default flips only with hardware
+    evidence — the scored artifact must not gamble on it."""
     import os
 
     return os.environ.get("POSEIDON_CHAINED") == "1"
@@ -284,7 +379,7 @@ def solve_wave_chained(
         return None
     B = -(-m_pad // K)
     M2 = K * B
-    scale, _ = derive_scale(
+    scale, max_raw_q = derive_scale(
         costs1, unsched1, max_cost_hint, e1_pad, m_pad
     )
 
@@ -416,7 +511,7 @@ def solve_wave_chained(
         np.asarray(rungs, dtype=np.int32),
         np.asarray([
             eps0, max(max_iter_total // 2, 1), global_update_every,
-            bf_max,
+            bf_max, max_raw_q,
         ], dtype=np.int32),
     ]).astype(np.int32)
     utilsB = np.zeros((3, M2), dtype=np.float32)
