@@ -59,9 +59,10 @@ python tools/profile_wave.py $WAVE_ARGS 2>&1 | tee "out/tpu_wave_stages.txt$SUFF
 echo "=== 4b. same, CHAINED single-dispatch wave (the live A/B that decides its default)"
 POSEIDON_CHAINED=1 python tools/profile_wave.py $WAVE_ARGS 2>&1 | tee "out/tpu_wave_chained.txt$SUFFIX"
 
-echo "=== 4c. same, host-seeded per-band path (fused pipeline OFF): the fused"
-echo "===     pipeline pays 3-4x the iterations for 2 fewer dispatches - at the"
-echo "===     measured ~1.5ms/iter this arm decides whether it stays accel-default"
+echo "=== 4c. same, host-seeded per-band path (fused pipeline OFF): true"
+echo "===     iteration counts are comparable (the old 3-4x was a metrics"
+echo "===     accounting artifact) - this arm prices the 2 extra dispatches"
+echo "===     against the one-program execution on real hardware"
 POSEIDON_COARSE_FUSED=0 python tools/profile_wave.py $WAVE_ARGS 2>&1 | tee "out/tpu_wave_hostseed.txt$SUFFIX"
 
 echo "=== 5. full bench ladder (tagged backend; partial lines salvage)"
