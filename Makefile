@@ -4,9 +4,9 @@
 
 PY ?= python
 
-.PHONY: all test test-fast bench protos native verify demo clean
+.PHONY: all test test-fast bench protos native verify lint demo clean
 
-all: protos native test
+all: protos native lint test
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -27,11 +27,23 @@ native:
 	$(PY) -c "from poseidon_tpu.native import native_available; \
 	  assert native_available(), 'native build failed'; print('native ok')"
 
-# Entry-point smoke: compile check + multichip dryrun + demo loop.
-verify:
-	$(PY) __graft_entry__.py
+# Static analysis: the posecheck suite (docs/CHECKS.md), ruff when
+# installed (the container may not ship it; config in pyproject.toml),
+# and the generated-proto staleness gate — one target gates all
+# mechanical hygiene (the analog of the reference's hack/verify-*).
+lint:
+	$(PY) -m poseidon_tpu.check poseidon_tpu/
+	@if command -v ruff >/dev/null 2>&1; then \
+	  ruff check .; \
+	else \
+	  echo "lint: ruff not installed; skipping (configs in pyproject.toml)"; \
+	fi
 	$(PY) -m poseidon_tpu.protos.gen
-	git diff --exit-code --stat -- poseidon_tpu/protos
+	git diff --exit-code --stat -- 'poseidon_tpu/protos/*_pb2.py'
+
+# Entry-point smoke: compile check + multichip dryrun + demo loop.
+verify: lint
+	$(PY) __graft_entry__.py
 
 demo:
 	$(PY) -m poseidon_tpu.glue.main --demo --scheduling-interval=2 \

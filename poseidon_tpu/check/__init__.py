@@ -1,0 +1,34 @@
+"""posecheck: codebase-aware static analysis for poseidon_tpu.
+
+Three rule families, each scoped to the subsystem whose failure mode it
+guards (see docs/CHECKS.md):
+
+- ``jit-purity``   — host-sync escapes inside jitted solver kernels
+                     (``ops/``, ``solver/``);
+- ``lock-discipline`` — unlocked writes to lock-guarded state in the
+                     threaded glue layer (``glue/``);
+- ``determinism``  — wall clock / unseeded RNG / unordered-set iteration
+                     in the replay and planning path (``replay/``,
+                     ``graph/``).
+
+CLI: ``python -m poseidon_tpu.check poseidon_tpu/`` (exit 1 on findings).
+Suppress a finding with a trailing ``# posecheck: ignore[rule-id]``.
+"""
+
+from poseidon_tpu.check.core import (
+    Finding,
+    Rule,
+    all_rules,
+    check_file,
+    rules_by_name,
+    run,
+)
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "all_rules",
+    "check_file",
+    "rules_by_name",
+    "run",
+]

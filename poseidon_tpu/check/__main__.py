@@ -1,0 +1,95 @@
+"""CLI: ``python -m poseidon_tpu.check [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 bad invocation.  Findings print as
+``file:line rule-id message`` (the Makefile's ``lint`` target and editors
+both parse that shape).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from poseidon_tpu.check.core import (
+    all_rules,
+    load_baseline,
+    run,
+    rules_by_name,
+    write_baseline,
+)
+
+_DEFAULT_BASELINE = Path(__file__).parent / "baseline.txt"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m poseidon_tpu.check",
+        description="posecheck: jit-purity / lock-discipline / determinism",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["poseidon_tpu/"],
+        help="files or directories to scan (default: poseidon_tpu/)",
+    )
+    parser.add_argument(
+        "--rule", action="append", dest="rules", metavar="RULE-ID",
+        help="run only this rule, on every given path regardless of its "
+             "default scope (repeatable); known: "
+             + ", ".join(r.name for r in all_rules()),
+    )
+    parser.add_argument(
+        "--baseline", type=Path, default=_DEFAULT_BASELINE,
+        help="baseline file of grandfathered findings "
+             "(default: the committed package baseline)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report baselined findings too",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline from the current findings and exit 0",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        rules = rules_by_name(args.rules) if args.rules else None
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 2
+
+    missing = [p for p in args.paths if not Path(p).exists()]
+    if missing:
+        print(f"no such path(s): {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    baseline = None if (args.no_baseline or args.write_baseline) \
+        else args.baseline
+    findings = run(
+        args.paths, rules=rules, baseline=baseline, root=Path.cwd()
+    )
+
+    if args.write_baseline:
+        write_baseline(args.baseline, findings)
+        print(
+            f"wrote {len(findings)} finding(s) to {args.baseline}",
+            file=sys.stderr,
+        )
+        return 0
+
+    for f in findings:
+        print(f.render())
+    if findings:
+        n_base = len(load_baseline(args.baseline)) if baseline else 0
+        suffix = f" ({n_base} baselined)" if n_base else ""
+        print(
+            f"posecheck: {len(findings)} finding(s){suffix}",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,256 @@
+"""determinism: wall-clock, unseeded RNG, and unordered-set iteration.
+
+Scope: ``poseidon_tpu/replay/`` and ``poseidon_tpu/graph/`` — the
+trace-replay and round-planning path whose whole value is bit-for-bit
+reproducibility (BASELINE parity runs, solver-vs-oracle verification,
+warm-start reuse across rounds).  Three leak classes:
+
+- ``time.time()``: real wall-clock in a virtual-time replay makes runs
+  incomparable.  (``time.perf_counter`` for *measuring* a round is fine
+  — it feeds telemetry, not decisions — so only ``time.time`` flags.)
+- unseeded RNG: module-level ``random.*`` / ``np.random.*`` draw from
+  process-global state seeded by the OS; ``np.random.default_rng(seed)``
+  / ``random.Random(seed)`` thread explicit streams instead.  A bare
+  ``default_rng()`` with no seed flags too.
+- iteration over bare ``set``s: set order varies with insertion history
+  and (for str keys) per-process hash randomization, so any ordering-
+  sensitive consumer — event lists, cost-matrix row order, serialized
+  output — silently diverges between runs.  ``sorted(set(...))`` is the
+  fix and never flags.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from poseidon_tpu.check.core import (
+    Finding,
+    Rule,
+    dotted_name,
+    from_imports,
+    import_aliases,
+)
+
+# Module-level random functions that draw from the global stream.
+_RANDOM_FNS = {
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "gauss", "normalvariate", "lognormvariate",
+    "expovariate", "betavariate", "gammavariate", "triangular",
+    "vonmisesvariate", "paretovariate", "weibullvariate", "getrandbits",
+    "randbytes",
+}
+
+# Call wrappers whose argument order is observable output order.
+_ORDER_SENSITIVE_WRAPPERS = {"list", "tuple", "enumerate", "iter"}
+
+
+def _is_set_expr(
+    node: ast.AST, set_vars: Set[str], set_fields: Set[str] = frozenset()
+) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        if node.func.id in ("set", "frozenset"):
+            return True
+    if isinstance(node, ast.Name) and node.id in set_vars:
+        return True
+    # Attribute whose name is a set-annotated field of a class defined in
+    # this module (e.g. a dataclass field ``subtree_uuids: Set[str]``):
+    # any ``x.subtree_uuids`` is assumed to be that set.
+    if isinstance(node, ast.Attribute) and node.attr in set_fields:
+        return True
+    return False
+
+
+def _set_annotated_fields(tree: ast.AST) -> Set[str]:
+    """Field names with a set-typed annotation on any class in the module
+    (class-level AnnAssign: ``name: Set[str]`` / ``name: set``)."""
+    fields: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                ann = stmt.annotation
+                base = ann.value if isinstance(ann, ast.Subscript) else ann
+                name = dotted_name(base)
+                if name and name.split(".")[-1] in (
+                    "Set", "set", "FrozenSet", "frozenset", "MutableSet",
+                ):
+                    fields.add(stmt.target.id)
+    return fields
+
+
+def _collect_set_vars(fn: ast.AST) -> Set[str]:
+    """Names bound to set expressions and never rebound to anything else
+    within this scope (module or one function; nested defs excluded)."""
+    sets: Set[str] = set()
+    other: Set[str] = set()
+
+    def walk_shallow(node: ast.AST):
+        # Walk statements without descending into nested function/class
+        # scopes (their bindings are theirs).
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                 ast.Lambda),
+            ):
+                continue
+            yield child
+            yield from walk_shallow(child)
+
+    for node in walk_shallow(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    if _is_set_expr(node.value, set()):
+                        sets.add(t.id)
+                    else:
+                        other.add(t.id)
+        elif isinstance(node, ast.AugAssign):
+            t = node.target
+            if isinstance(t, ast.Name):
+                # Set-algebra updates (s |= other, s -= dead, ...) keep a
+                # tracked set a set; anything else unmarks it.
+                keeps = isinstance(
+                    node.op, (ast.BitOr, ast.BitAnd, ast.BitXor, ast.Sub)
+                ) and (t.id in sets or _is_set_expr(node.value, sets))
+                if not keeps:
+                    other.add(t.id)
+        elif isinstance(node, ast.AnnAssign):
+            t = node.target
+            if isinstance(t, ast.Name):
+                other.add(t.id)
+        elif isinstance(node, (ast.For, ast.comprehension)):
+            t = node.target
+            if isinstance(t, ast.Name):
+                other.add(t.id)
+    return sets - other
+
+
+class DeterminismRule(Rule):
+    name = "determinism"
+    scopes = ("poseidon_tpu/replay/", "poseidon_tpu/graph/")
+
+    def check(self, tree: ast.AST, source: str, path: str) -> List[Finding]:
+        time_aliases = import_aliases(tree, "time")
+        time_fns = {
+            local
+            for local, orig in from_imports(tree, "time").items()
+            if orig == "time"
+        }
+        random_aliases = import_aliases(tree, "random")
+        random_fns = {
+            local: orig
+            for local, orig in from_imports(tree, "random").items()
+            if orig in _RANDOM_FNS
+        }
+        np_aliases = import_aliases(tree, "numpy")
+
+        findings: List[Finding] = []
+
+        def flag(node: ast.AST, message: str) -> None:
+            findings.append(Finding(path, node.lineno, self.name, message))
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                self._check_call(
+                    node, flag, time_aliases, time_fns, random_aliases,
+                    random_fns, np_aliases,
+                )
+
+        # Set iteration: per-scope variable tracking, then flag iteration
+        # sites.  Scopes: the module plus every function (nested included —
+        # ast.walk reaches them; each tracks only its own bindings).
+        scopes: List[ast.AST] = [tree]
+        scopes.extend(
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        )
+        set_fields = _set_annotated_fields(tree)
+        for scope in scopes:
+            set_vars = _collect_set_vars(scope)
+            self._check_set_iteration(scope, set_vars, set_fields, flag)
+        return findings
+
+    # -- wall clock + RNG --------------------------------------------------
+
+    def _check_call(
+        self, node, flag, time_aliases, time_fns, random_aliases,
+        random_fns, np_aliases,
+    ) -> None:
+        fname = dotted_name(node.func)
+        if fname is None:
+            return
+        head, _, rest = fname.partition(".")
+        if (head in time_aliases and rest == "time") or (
+            not rest and head in time_fns
+        ):
+            flag(node, "wall-clock `time.time()` in the replay/parity "
+                       "path; use the driver's virtual time or inject a "
+                       "clock")
+            return
+        if head in random_aliases and rest in _RANDOM_FNS:
+            flag(node, f"unseeded global RNG `{fname}()`; thread a seeded "
+                       "`random.Random(seed)` through instead")
+            return
+        if not rest and head in random_fns:
+            flag(node, f"unseeded global RNG `random.{random_fns[head]}()`"
+                       "; thread a seeded `random.Random(seed)` through "
+                       "instead")
+            return
+        if head in np_aliases and rest.startswith("random."):
+            sub = rest[len("random."):]
+            if sub == "default_rng":
+                if not node.args and not node.keywords:
+                    flag(node, "`default_rng()` without a seed draws OS "
+                               "entropy; pass an explicit seed")
+            elif sub not in ("Generator", "RandomState", "SeedSequence"):
+                flag(node, f"unseeded global RNG `{fname}()`; use "
+                           "`np.random.default_rng(seed)` streams")
+
+    # -- set iteration -----------------------------------------------------
+
+    def _check_set_iteration(self, scope, set_vars, set_fields, flag) -> None:
+        def shallow(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                ):
+                    continue
+                yield child
+                yield from shallow(child)
+
+        msg = (
+            "iteration over an unordered set feeds ordering-sensitive "
+            "output; wrap in sorted(...)"
+        )
+        for node in shallow(scope):
+            if isinstance(node, ast.For) and _is_set_expr(
+                node.iter, set_vars, set_fields
+            ):
+                flag(node.iter, msg)
+            elif isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+                for comp in node.generators:
+                    if _is_set_expr(comp.iter, set_vars, set_fields):
+                        flag(comp.iter, msg)
+            elif isinstance(node, ast.Call):
+                if (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in _ORDER_SENSITIVE_WRAPPERS
+                    and node.args
+                    and _is_set_expr(node.args[0], set_vars, set_fields)
+                ):
+                    flag(node, msg)
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"
+                    and node.args
+                    and _is_set_expr(node.args[0], set_vars, set_fields)
+                ):
+                    flag(node, msg)

@@ -1,0 +1,4 @@
+# Fixture files for the posecheck self-tests (tests/test_check_selfcheck.py).
+# The *_violations.py files contain seeded findings ON PURPOSE; the default
+# repo walk skips this directory (core._SKIP_FRAGMENTS), and ruff excludes it.
+# These modules are parsed, never imported.

@@ -1,0 +1,29 @@
+"""determinism clean fixture: seeded RNG streams, virtual time, and
+sorted iteration over sets."""
+
+import time
+
+import numpy as np
+
+
+def seeded_trace(seed: int):
+    rng = np.random.default_rng(seed)          # seeded stream: fine
+    return rng.uniform(0.0, 1.0, size=8)
+
+
+def measure(fn):
+    # perf_counter feeds telemetry, not decisions: not flagged.
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def stable_order(uuids):
+    pending = set(uuids)
+    # sorted() normalizes set order before it can leak into output.
+    report = [u.upper() for u in sorted(pending)]
+    for u in sorted({x for x in uuids if x}):
+        report.append(u)
+    if "m0" in pending:                         # membership tests are fine
+        report.append("m0")
+    return report

@@ -1,0 +1,48 @@
+"""determinism violation fixture: wall clock, unseeded RNG, set iteration.
+
+Expected findings:
+  - time.time() wall clock                       (2: dotted + from-import)
+  - unseeded global random.* / np.random.*       (3)
+  - default_rng() with no seed                   (1)
+  - iteration over bare sets                     (5: for / comprehension /
+                                                  list() / tracked var /
+                                                  var grown via |=)
+  - suppressed time.time() does NOT count
+"""
+
+import random
+import time
+from time import time as now
+
+import numpy as np
+
+
+def stamp_events(events):
+    t = time.time()                         # VIOLATION: wall clock
+    t2 = now()                              # VIOLATION: wall clock (alias)
+    ok = time.time()                        # posecheck: ignore[determinism]
+    return [(t, t2, ok, e) for e in events]
+
+
+def jitter(n):
+    a = random.random()                     # VIOLATION: global RNG
+    b = np.random.uniform(0, 1, size=n)     # VIOLATION: global np RNG
+    c = random.shuffle(list(range(n)))      # VIOLATION: global RNG
+    rng = np.random.default_rng()           # VIOLATION: unseeded default_rng
+    return a, b, c, rng.integers(0, n)
+
+
+def leak_order(uuids):
+    pending = set(uuids)
+    out = []
+    for u in pending:                       # VIOLATION: tracked set var
+        out.append(u)
+    for u in {x for x in uuids}:            # VIOLATION: set comprehension
+        out.append(u)
+    out.extend(list(set(uuids)))            # VIOLATION: list(set(...))
+    out.extend(x for x in set(uuids))       # VIOLATION: genexp over set
+    grown = set(uuids)
+    grown |= {"extra"}                      # set algebra keeps it a set
+    for u in grown:                         # VIOLATION: still unordered
+        out.append(u)
+    return out

@@ -1,0 +1,35 @@
+"""jit-purity clean fixture: jitted kernels that stay on device, plus
+host-side wrapper code that may use numpy freely (out of jit scope)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _helper(x):
+    # Same-module callee of a jitted function: must stay pure too.
+    return jnp.maximum(x, 0)
+
+
+@jax.jit
+def kernel(x):
+    y = _helper(x)
+    jax.debug.print("y={y}", y=y)  # the sanctioned print
+    return y.astype(jnp.int32) * 2
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def kernel_static(x, *, n):
+    # int() on a literal is a host-time constant, not a tracer sync.
+    return x + int("4") + n
+
+
+def host_wrapper(arr):
+    # NOT in jit scope: numpy materialization and .item() are fine here.
+    a = np.asarray(arr, dtype=np.int32)
+    out = kernel(jnp.asarray(a))
+    total = float(np.asarray(out).sum())
+    print("host-side report:", total)
+    return int(out[0].item())

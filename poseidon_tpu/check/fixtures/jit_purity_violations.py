@@ -1,0 +1,44 @@
+"""jit-purity violation fixture: every host-sync escape class, seeded.
+
+Expected findings (tests/test_check_selfcheck.py asserts these):
+  - np.asarray / np.array inside jit scope        (2)
+  - .item() inside jit scope                      (1)
+  - float()/int() tracer casts inside jit scope   (2)
+  - jax.device_get inside jit scope               (1)
+  - bare print inside jit scope                   (2: direct + callee)
+  - suppressed np.asarray does NOT count
+"""
+
+import functools
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _leaky_callee(x):
+    # Joins jit scope through direct_call below: flagged through closure.
+    print("inside the kernel", x)
+    return x
+
+
+@jax.jit
+def direct_call(x):
+    y = np.asarray(x)                     # VIOLATION: host materialization
+    z = np.array([1, 2, 3])               # VIOLATION: host materialization
+    w = jax.device_get(x)                 # VIOLATION: explicit device->host
+    s = x.sum().item()                    # VIOLATION: .item() sync
+    f = float(x[0])                       # VIOLATION: tracer cast
+    i = int(y.sum())                      # VIOLATION: tracer cast
+    print("shape", x.shape)               # VIOLATION: bare print
+    ok = np.asarray(x)                    # posecheck: ignore[jit-purity]
+    return _leaky_callee(jnp.asarray(y) + z.sum() + w + s + f + i + ok[0])
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def partial_decorated(x):
+    return x * 2
+
+
+scanned_alias = partial(jax.jit, static_argnames=())(partial_decorated)

@@ -1,0 +1,170 @@
+"""jit-purity: host-sync escapes inside jitted hot paths.
+
+Scope: ``poseidon_tpu/ops/`` and ``poseidon_tpu/solver/`` — the solver
+kernels whose latency is the critical path of a scheduling round.  A
+``np.asarray`` / ``.item()`` / ``float()`` on a tracer inside a jitted
+function either fails at trace time or (worse, under ``jax.pure_callback``
+-style escapes) silently forces a device->host round trip per dispatch —
+on the tunneled production TPU that is a ~60-116 ms tax per occurrence
+(tools/profile_transfer.py), invisible in CPU tests.
+
+Detection is call-graph aware within a module: every function decorated
+with ``jax.jit`` / ``functools.partial(jax.jit, ...)`` (or wrapped via a
+module-level ``g = jax.jit(f)``) seeds the *jit scope*; any module-level
+function a scoped function references (direct call, ``lax.scan``/``cond``
+operand, ``partial`` argument) joins the scope transitively.  Host-side
+wrapper code around the dispatch — the bulk of ``ops/transport.py`` —
+stays out of scope and may use numpy freely.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from poseidon_tpu.check.core import (
+    Finding,
+    Rule,
+    dotted_name,
+    from_imports,
+    import_aliases,
+)
+
+
+def _jit_names(tree: ast.AST) -> Set[str]:
+    """Dotted names that denote jax.jit in this module."""
+    names = {"jax.jit"}
+    for alias in import_aliases(tree, "jax"):
+        names.add(f"{alias}.jit")
+    for local, orig in from_imports(tree, "jax").items():
+        if orig == "jit":
+            names.add(local)
+    return names
+
+
+def _partial_names(tree: ast.AST) -> Set[str]:
+    names = {"functools.partial"}
+    for alias in import_aliases(tree, "functools"):
+        names.add(f"{alias}.partial")
+    for local, orig in from_imports(tree, "functools").items():
+        if orig == "partial":
+            names.add(local)
+    return names
+
+
+def _is_jit_expr(node: ast.AST, jit: Set[str], partials: Set[str]) -> bool:
+    """Does this decorator/value expression produce a jitted callable?"""
+    name = dotted_name(node)
+    if name in jit:
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname in jit:
+            return True
+        if fname in partials and node.args:
+            return _is_jit_expr(node.args[0], jit, partials)
+    return False
+
+
+class JitPurityRule(Rule):
+    name = "jit-purity"
+    scopes = ("poseidon_tpu/ops/", "poseidon_tpu/solver/")
+
+    def check(self, tree: ast.AST, source: str, path: str) -> List[Finding]:
+        assert isinstance(tree, ast.Module)
+        jit = _jit_names(tree)
+        partials = _partial_names(tree)
+        np_aliases = import_aliases(tree, "numpy")
+        jax_aliases = import_aliases(tree, "jax") | {"jax"}
+
+        table: Dict[str, ast.FunctionDef] = {}
+        seeds: Set[str] = set()
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                table[node.name] = node
+                if any(
+                    _is_jit_expr(d, jit, partials)
+                    for d in node.decorator_list
+                ):
+                    seeds.add(node.name)
+            elif isinstance(node, ast.Assign):
+                # g = jax.jit(f) / g = partial(jax.jit, ...)(f)
+                v = node.value
+                if (
+                    isinstance(v, ast.Call)
+                    and _is_jit_expr(v.func, jit, partials)
+                    and v.args
+                ):
+                    inner = dotted_name(v.args[0])
+                    if inner and "." not in inner:
+                        seeds.add(inner)
+
+        # Transitive same-module closure over name references.
+        scope: Set[str] = set()
+        frontier = [s for s in seeds if s in table]
+        while frontier:
+            fn = frontier.pop()
+            if fn in scope:
+                continue
+            scope.add(fn)
+            for node in ast.walk(table[fn]):
+                if (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in table
+                    and node.id not in scope
+                ):
+                    frontier.append(node.id)
+
+        findings: List[Finding] = []
+        for fn in sorted(scope):
+            findings.extend(
+                self._check_function(table[fn], path, np_aliases, jax_aliases)
+            )
+        return findings
+
+    def _check_function(
+        self,
+        fn: ast.FunctionDef,
+        path: str,
+        np_aliases: Set[str],
+        jax_aliases: Set[str],
+    ) -> List[Finding]:
+        out: List[Finding] = []
+
+        def flag(node: ast.AST, message: str) -> None:
+            out.append(
+                Finding(path, node.lineno, self.name,
+                        f"{message} [in jit scope `{fn.name}`]")
+            )
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func)
+            if fname:
+                head, _, rest = fname.partition(".")
+                if head in np_aliases and rest in ("asarray", "array"):
+                    flag(node, f"host materialization `{fname}()`; use "
+                               "jnp equivalents or hoist out of the jit")
+                    continue
+                if head in jax_aliases and rest == "device_get":
+                    flag(node, f"`{fname}()` forces a device->host "
+                               "transfer; return the array instead")
+                    continue
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item" and not node.args:
+                flag(node, "`.item()` synchronizes device->host; keep the "
+                           "value as a traced scalar")
+                continue
+            if isinstance(node.func, ast.Name):
+                if node.func.id == "print":
+                    flag(node, "bare `print()` does not trace; use "
+                               "`jax.debug.print`")
+                    continue
+                if node.func.id in ("float", "int") and any(
+                    not isinstance(a, ast.Constant) for a in node.args
+                ):
+                    flag(node, f"`{node.func.id}()` cast concretizes a "
+                               "tracer (host sync); use jnp casts/astype")
+        return out

@@ -9,7 +9,7 @@ enough to fuse (the CPU/Mem model's arithmetic is all broadcastable).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 

@@ -10,7 +10,7 @@ then each distinct selector is one numpy membership test over machines.
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, Sequence, Tuple
 
 import numpy as np
 

@@ -18,7 +18,7 @@ from __future__ import annotations
 import logging
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set
+from typing import Dict, List
 
 from poseidon_tpu.glue.fake_kube import KubeAPI, Pod
 from poseidon_tpu.glue.keyed_queue import KeyedQueue

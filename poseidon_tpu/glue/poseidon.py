@@ -17,8 +17,8 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from dataclasses import dataclass
+from typing import List, Optional
 
 from poseidon_tpu.glue.fake_kube import KubeAPI
 from poseidon_tpu.glue.nodewatcher import NodeWatcher

@@ -28,7 +28,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from poseidon_tpu.graph.ecs import Selector, canonical_selectors, ec_signature
+from poseidon_tpu.graph.ecs import Selector, ec_signature
 
 
 class TaskReply(enum.IntEnum):
@@ -389,7 +389,9 @@ class ClusterState:
                 return NodeReply.ALREADY_EXISTS
             self.machines[machine.uuid] = machine
             self.resource_to_machine[machine.uuid] = machine.uuid
-            for sub in machine.subtree_uuids:
+            # sorted(): dict insertion order is observable (snapshots,
+            # debug dumps) and set order is not reproducible across runs.
+            for sub in sorted(machine.subtree_uuids):
                 self.resource_to_machine[sub] = machine.uuid
             if self._native is not None:
                 self._native.machine_add(
@@ -438,7 +440,7 @@ class ClusterState:
             if machine is None:
                 return NodeReply.NOT_FOUND
             self.resource_to_machine.pop(machine.uuid, None)
-            for sub in machine.subtree_uuids:
+            for sub in sorted(machine.subtree_uuids):
                 self.resource_to_machine.pop(sub, None)
             self.node_kb.pop(machine.uuid, None)
             self._evict_tasks_on(machine.uuid)
@@ -470,7 +472,7 @@ class ClusterState:
                     existing.ram_capacity, existing.net_rx_capacity,
                     existing.task_slots,
                 )
-            for sub in machine.subtree_uuids:
+            for sub in sorted(machine.subtree_uuids):
                 existing.subtree_uuids.add(sub)
                 self.resource_to_machine[sub] = existing.uuid
             self.generation += 1
@@ -628,7 +630,7 @@ class ClusterState:
             np.maximum(np.rint(ram_obs), 0).astype(np.int64),
         )
 
-    def build_round_view(self, include_running: bool = False):
+    def build_round_view(self, include_running: bool = False) -> "RoundView":
         """Columnar tables for one round, built in a single pass under the
         lock (no per-task object copies: at 100k tasks the copy/per-object
         property overhead of a deep snapshot costs ~1.5s of the <1s round
@@ -828,7 +830,7 @@ class ClusterState:
                 generation=self.generation,
             )
 
-    def _build_view_native(self, include_running: bool):
+    def _build_view_native(self, include_running: bool) -> "RoundView":
         """Round view via the C++ graph core: the O(N) aggregation,
         grouping and sorting run native; Python assembles the per-EC
         attribute tables from the (few) representative tasks."""

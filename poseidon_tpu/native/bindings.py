@@ -9,7 +9,6 @@ translation unit.
 from __future__ import annotations
 
 import ctypes
-import os
 import subprocess
 import threading
 from pathlib import Path
@@ -146,7 +145,9 @@ class NativeGraphCore:
     def task_place(self, uid, machine_key) -> None:
         self._lib.gc_task_place(self._h, uid, machine_key)
 
-    def task_place_batch(self, uids: np.ndarray, machine_keys: np.ndarray):
+    def task_place_batch(
+        self, uids: np.ndarray, machine_keys: np.ndarray
+    ) -> int:
         """Batched placement commit (one C call for a whole round)."""
         uids = np.ascontiguousarray(uids, dtype=np.uint64)
         keys = np.ascontiguousarray(machine_keys, dtype=np.uint64)

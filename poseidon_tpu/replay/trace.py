@@ -9,9 +9,8 @@ process.  Events are (time, kind, payload) tuples replayed in order.
 
 from __future__ import annotations
 
-import heapq
-from dataclasses import dataclass, field
-from typing import Iterator, List, Optional, Tuple
+from dataclasses import dataclass
+from typing import List, Tuple
 
 import numpy as np
 

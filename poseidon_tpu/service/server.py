@@ -13,7 +13,6 @@ Firmament's.
 
 from __future__ import annotations
 
-import argparse
 import logging
 import os
 import signal

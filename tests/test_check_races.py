@@ -1,0 +1,188 @@
+"""Runtime race harness for the glue layer's KeyedQueue.
+
+The lock-discipline rule is lexical; this is the dynamic half (the role
+`go test -race` plays in the reference repo): an instrumented wrapper
+asserts the queue's core invariant — at most one worker processes a
+given key at a time, items per key are processed in arrival order, and
+nothing is lost or duplicated — under an 8-thread add/get/done/shutdown
+storm.  Plus deterministic edge-case coverage: done() on an unknown
+key, add() after shutdown, parked-item re-entry ordering.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Any, Dict, Hashable, List
+
+import pytest
+
+from poseidon_tpu.glue.keyed_queue import KeyedQueue
+
+WORKERS = 8
+KEYS = 12
+ITEMS_PER_KEY = 60
+
+
+class InvariantTracker:
+    """Records per-key processing sections and fails on any overlap."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._in_flight: Dict[Hashable, str] = {}   # key -> worker name
+        self.violations: List[str] = []
+        self.processed: Dict[Hashable, List[Any]] = defaultdict(list)
+
+    def enter(self, key: Hashable, items: List[Any], worker: str) -> None:
+        with self._mu:
+            holder = self._in_flight.get(key)
+            if holder is not None:
+                self.violations.append(
+                    f"key {key!r} processed concurrently by {holder} "
+                    f"and {worker}"
+                )
+            self._in_flight[key] = worker
+            self.processed[key].extend(items)
+
+    def exit(self, key: Hashable, worker: str) -> None:
+        with self._mu:
+            if self._in_flight.get(key) == worker:
+                del self._in_flight[key]
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_keyed_queue_stress_no_concurrent_processing(seed):
+    q = KeyedQueue()
+    tracker = InvariantTracker()
+
+    def producer(offset: int) -> None:
+        # Interleave keys so parking (add during processing) is constant.
+        for i in range(ITEMS_PER_KEY):
+            for k in range(KEYS):
+                q.add(f"k{k}", (k, offset * ITEMS_PER_KEY + i))
+
+    def worker(name: str) -> None:
+        while True:
+            batch = q.get()
+            if batch is None:
+                return
+            key, items = batch
+            tracker.enter(key, items, name)
+            # No sleep: maximal contention on done()/add() interleaving.
+            tracker.exit(key, name)
+            q.done(key)
+
+    producers = [
+        threading.Thread(target=producer, args=(p,)) for p in range(2)
+    ]
+    workers = [
+        threading.Thread(target=worker, args=(f"w{i}",))
+        for i in range(WORKERS)
+    ]
+    for t in producers + workers:
+        t.start()
+    for t in producers:
+        t.join(timeout=30)
+        assert not t.is_alive(), "producer failed to finish"
+    # Drain: wait until queued + parked + in-processing reaches zero,
+    # then shut down so workers exit.
+    deadline = threading.Event()
+    for _ in range(30_000):
+        if len(q) == 0:
+            break
+        deadline.wait(0.001)
+    assert len(q) == 0, "queue failed to drain"
+    q.shut_down()
+    for t in workers:
+        t.join(timeout=30)
+        assert not t.is_alive(), "worker failed to exit after shutdown"
+
+    assert tracker.violations == []
+    total = 2 * KEYS * ITEMS_PER_KEY
+    got = sum(len(v) for v in tracker.processed.values())
+    assert got == total, f"lost/duplicated items: {got} != {total}"
+    for k in range(KEYS):
+        items = [i for (kk, i) in tracker.processed[f"k{k}"] if kk == k]
+        assert len(items) == 2 * ITEMS_PER_KEY
+        # Per-producer arrival order is preserved per key (the two
+        # producers interleave arbitrarily between each other).
+        first = [i for i in items if i < ITEMS_PER_KEY]
+        second = [i for i in items if i >= ITEMS_PER_KEY]
+        assert first == sorted(first)
+        assert second == sorted(second)
+
+
+# ------------------------------------------------------------- edge cases
+
+
+def test_done_on_unknown_key_is_noop():
+    q = KeyedQueue()
+    q.done("never-seen")          # must not raise or corrupt state
+    assert len(q) == 0
+    q.add("k", 1)
+    q.done("unrelated")
+    key, items = q.get()
+    assert (key, items) == ("k", [1])
+    q.done("k")
+    assert len(q) == 0
+
+
+def test_add_after_shutdown_is_dropped():
+    q = KeyedQueue()
+    q.add("a", 1)
+    q.shut_down()
+    q.add("a", 2)                 # dropped, not queued
+    q.add("b", 3)                 # dropped, not queued
+    key, items = q.get()          # pre-shutdown work still drains
+    assert (key, items) == ("a", [1])
+    q.done("a")
+    assert q.get() is None        # then the queue reports drained
+    assert len(q) == 0
+
+
+def test_parked_items_reenter_in_order():
+    q = KeyedQueue()
+    q.add("k", "a")
+    key, items = q.get()
+    assert (key, items) == ("k", ["a"])
+    # Adds while "k" is processing park in the side queue...
+    q.add("k", "b")
+    q.add("k", "c")
+    # ...and other keys are still deliverable meanwhile.
+    q.add("other", "x")
+    key2, items2 = q.get()
+    assert (key2, items2) == ("other", ["x"])
+    q.done("other")
+    # done() releases "k": the parked batch re-enters in arrival order.
+    q.done("k")
+    key3, items3 = q.get()
+    assert (key3, items3) == ("k", ["b", "c"])
+    q.done("k")
+    assert len(q) == 0
+
+
+def test_parked_reentry_preserves_fifo_against_later_keys():
+    q = KeyedQueue()
+    q.add("k", 1)
+    assert q.get()[0] == "k"
+    q.add("k", 2)      # parks
+    q.add("late", 9)   # queued behind nothing
+    q.done("k")        # parked batch re-enters AFTER already-queued keys
+    assert q.get()[0] == "late"
+    assert q.get() == ("k", [2])
+
+
+def test_len_counts_processing_keys():
+    q = KeyedQueue()
+    q.add("k", 1)
+    assert len(q) == 1
+    q.get()
+    # Popped but not done(): still outstanding.
+    assert len(q) == 1
+    q.add("k", 2)      # parked
+    assert len(q) == 2
+    q.done("k")
+    assert len(q) == 1  # parked item re-entered the main queue
+    q.get()
+    q.done("k")
+    assert len(q) == 0

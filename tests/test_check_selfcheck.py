@@ -1,0 +1,183 @@
+"""Self-tests for the posecheck static-analysis suite.
+
+Each rule runs against a committed clean fixture (zero findings) and a
+seeded-violation fixture (exact expected findings), so a regression in a
+checker — silently matching nothing is the classic failure mode of
+AST lints — fails tier-1, not code review.  The CLI contract (exit
+codes, output shape, suppressions, baseline) is covered too, and the
+whole repo must scan clean.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from poseidon_tpu.check import check_file, rules_by_name, run
+from poseidon_tpu.check.__main__ import main as check_main
+from poseidon_tpu.check.core import (
+    Finding,
+    apply_suppressions,
+    load_baseline,
+    suppressions,
+    write_baseline,
+)
+
+FIXTURES = Path(__file__).parent.parent / "poseidon_tpu" / "check" / "fixtures"
+REPO = Path(__file__).parent.parent
+
+
+def _findings(rule: str, fixture: str):
+    return check_file(
+        FIXTURES / fixture, rules_by_name([rule]), forced=True, root=REPO
+    )
+
+
+# ------------------------------------------------------------------ fixtures
+
+
+@pytest.mark.parametrize(
+    "rule,fixture",
+    [
+        ("jit-purity", "jit_purity_clean.py"),
+        ("lock-discipline", "lock_discipline_clean.py"),
+        ("determinism", "determinism_clean.py"),
+    ],
+)
+def test_clean_fixture_has_no_findings(rule, fixture):
+    assert _findings(rule, fixture) == []
+
+
+def test_jit_purity_violations():
+    found = _findings("jit-purity", "jit_purity_violations.py")
+    msgs = [f.message for f in found]
+    assert len(found) == 8
+    assert sum("np.asarray" in m or "np.array" in m for m in msgs) == 2
+    assert sum(".item()" in m for m in msgs) == 1
+    assert sum("cast concretizes" in m for m in msgs) == 2
+    assert sum("device_get" in m for m in msgs) == 1
+    assert sum("print" in m for m in msgs) == 2
+    # The closure reaches same-module callees of jitted functions.
+    assert any("_leaky_callee" in m for m in msgs)
+    # The suppressed np.asarray on the `ok = ...` line did not count.
+    assert all(f.rule == "jit-purity" for f in found)
+
+
+def test_lock_discipline_violations():
+    found = _findings("lock-discipline", "lock_discipline_violations.py")
+    assert len(found) == 7
+    import re
+
+    by_method = {
+        re.search(r"\((\w+\.\w+)\); the lock guards", f.message).group(1)
+        for f in found
+    }
+    assert by_method == {
+        "RacyRegistry.racy_set", "RacyRegistry.racy_put",
+        "RacyRegistry.racy_append", "RacyRegistry.racy_bump",
+        "RacyRegistry._helper", "RacyCond.drop_all",
+        "ThreadTargetEscape._worker",
+    }
+
+
+def test_determinism_violations():
+    found = _findings("determinism", "determinism_violations.py")
+    msgs = [f.message for f in found]
+    assert len(found) == 11
+    assert sum("wall-clock" in m for m in msgs) == 2
+    assert sum("unseeded global RNG" in m for m in msgs) == 3
+    assert sum("without a seed" in m for m in msgs) == 1
+    assert sum("unordered set" in m for m in msgs) == 5
+
+
+# ---------------------------------------------------------------- mechanics
+
+
+def test_suppression_parsing():
+    src = (
+        "x = 1  # posecheck: ignore[jit-purity]\n"
+        "y = 2  # posecheck: ignore[jit-purity, determinism]\n"
+        "z = 3  # posecheck: ignore\n"
+        "w = 4\n"
+    )
+    supp = suppressions(src)
+    assert supp[1] == {"jit-purity"}
+    assert supp[2] == {"jit-purity", "determinism"}
+    assert supp[3] is None
+    assert 4 not in supp
+
+    findings = [
+        Finding("f.py", 1, "jit-purity", "a"),
+        Finding("f.py", 1, "determinism", "kept: wrong rule"),
+        Finding("f.py", 3, "lock-discipline", "any rule suppressed"),
+        Finding("f.py", 4, "determinism", "kept: no comment"),
+    ]
+    kept = apply_suppressions(findings, src)
+    assert [f.message for f in kept] == ["kept: wrong rule",
+                                         "kept: no comment"]
+
+
+def test_baseline_round_trip(tmp_path):
+    baseline = tmp_path / "baseline.txt"
+    findings = [
+        Finding("a.py", 3, "determinism", "msg one"),
+        Finding("b.py", 9, "jit-purity", "msg two"),
+    ]
+    write_baseline(baseline, findings)
+    keys = load_baseline(baseline)
+    assert len(keys) == 2
+    assert all(f.baseline_key() in keys for f in findings)
+    # Line drift does not invalidate a baseline entry.
+    moved = Finding("a.py", 33, "determinism", "msg one")
+    assert moved.baseline_key() in keys
+
+
+def test_unknown_rule_is_usage_error(capsys):
+    assert check_main(["--rule", "no-such-rule", "."]) == 2
+    assert check_main(["poseidon_tpu/does/not/exist.py"]) == 2
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = FIXTURES / "determinism_violations.py"
+    assert check_main(
+        ["--rule", "determinism", str(FIXTURES / "determinism_clean.py")]
+    ) == 0
+    assert check_main(["--rule", "determinism", str(bad)]) == 1
+    # A baseline grandfathers the findings back to exit 0.
+    baseline = tmp_path / "b.txt"
+    assert check_main(
+        ["--rule", "determinism", "--write-baseline",
+         "--baseline", str(baseline), str(bad)]
+    ) == 0
+    assert check_main(
+        ["--rule", "determinism", "--baseline", str(baseline), str(bad)]
+    ) == 0
+    # --no-baseline reports them again.
+    assert check_main(
+        ["--rule", "determinism", "--baseline", str(baseline),
+         "--no-baseline", str(bad)]
+    ) == 1
+
+
+def test_output_shape(capsys):
+    check_main(["--rule", "determinism",
+                str(FIXTURES / "determinism_violations.py")])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out, "violations must print"
+    for line in out:
+        # file:line rule-id message
+        loc, rule, _msg = line.split(" ", 2)
+        path, lineno = loc.rsplit(":", 1)
+        assert path.endswith("determinism_violations.py")
+        assert int(lineno) > 0
+        assert rule == "determinism"
+
+
+# ------------------------------------------------------------------- repo
+
+
+def test_repo_scans_clean():
+    """The gate the Makefile's lint target enforces, as a tier-1 test."""
+    findings = run([str(REPO / "poseidon_tpu")], root=REPO)
+    assert findings == [], "\n".join(f.render() for f in findings)

@@ -13,7 +13,7 @@ from poseidon_tpu.costmodel import (
     get_cost_model,
     selector_admissibility,
 )
-from poseidon_tpu.costmodel.base import ECTable, MachineTable, NORMALIZED_COST
+from poseidon_tpu.costmodel.base import ECTable, MachineTable
 from poseidon_tpu.costmodel.selectors import (
     EXISTS_KEY,
     IN_SET,

@@ -5,13 +5,11 @@ Each test drives the model end-to-end through a RoundPlanner so the census
 pure cost arithmetic.
 """
 
-import numpy as np
 
 from poseidon_tpu.costmodel import get_cost_model
 from poseidon_tpu.graph.instance import RoundPlanner
 from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
-from poseidon_tpu.ops.transport import INF_COST
-from poseidon_tpu.utils.ids import generate_uuid, task_uid
+from poseidon_tpu.utils.ids import generate_uuid
 
 SHEEP, RABBIT, DEVIL, TURTLE = 0, 1, 2, 3
 

@@ -5,7 +5,6 @@ repair loop forbids partially-placed gangs and re-solves so freed capacity
 serves other work.
 """
 
-import numpy as np
 
 from poseidon_tpu.costmodel import get_cost_model
 from poseidon_tpu.glue import FakeKube, Node, Pod, Poseidon

@@ -18,7 +18,7 @@ from poseidon_tpu.glue import FakeKube, Node, Pod, Poseidon
 from poseidon_tpu.glue.keyed_queue import KeyedQueue
 from poseidon_tpu.protos import stats_pb2 as spb
 from poseidon_tpu.protos.services import STATS_METHODS, STATS_SERVICE, make_stubs
-from poseidon_tpu.service import FirmamentClient, FirmamentTPUServer
+from poseidon_tpu.service import FirmamentTPUServer
 from poseidon_tpu.utils.config import PoseidonConfig
 
 

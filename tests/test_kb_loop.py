@@ -7,7 +7,6 @@ tests pin the loop end to end: stats in -> observed machine load /
 observed interference class -> different placement out.
 """
 
-import numpy as np
 
 from poseidon_tpu.costmodel import get_cost_model
 from poseidon_tpu.graph.instance import RoundPlanner

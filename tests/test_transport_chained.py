@@ -6,7 +6,6 @@ placed task (band 2's costs are built in float32 on device vs float64
 on host — see costmodel/device_build.py)."""
 
 import numpy as np
-import pytest
 
 from poseidon_tpu.costmodel.cpu_mem import CpuMemCostModel
 from poseidon_tpu.graph.instance import RoundPlanner
