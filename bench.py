@@ -517,7 +517,27 @@ def run_features(machines: int, rounds: int) -> dict:
     from poseidon_tpu.costmodel.selectors import IN_SET
     from poseidon_tpu.graph.instance import RoundPlanner
     from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+    from poseidon_tpu.utils import stagetimer
     from poseidon_tpu.utils.ids import generate_uuid, task_uid
+
+    # Per-stage sub-timings for the constraint rounds: the affinity and
+    # gang configs are host-masking-bound, not solver-bound, so the
+    # artifact carries where the round actually went (mask build vs
+    # cost build vs solve) next to the headline latency.
+    os.environ["POSEIDON_STAGE_TIMERS"] = "1"
+
+    def _stage_timings() -> dict:
+        snap = stagetimer.snapshot()
+        timings = {}
+        for label, key in (
+            ("mask_build_s", "round.mask_build"),
+            ("cost_build_s", "round.cost_build"),
+            ("solve_s", "round.solve_band"),
+            ("view_build_s", "round.view_build"),
+        ):
+            total, _calls = snap.get(key, (0.0, 0))
+            timings[label] = round(total, 4)
+        return timings
 
     out = {"backend": jax.devices()[0].platform, "ok": False}
     tasks = machines * 5
@@ -603,6 +623,7 @@ def run_features(machines: int, rounds: int) -> dict:
             cpu_request=200, ram_request=1 << 19,
             pod_affinity=((IN_SET, "app", (f"db{i}",)),),
         ))
+    stagetimer.reset()
     t0 = time.perf_counter()
     planner.schedule_round()
     aff_s = time.perf_counter() - t0
@@ -616,6 +637,7 @@ def run_features(machines: int, rounds: int) -> dict:
         "round_s": round(aff_s, 4),
         "targets": n_targets,
         "colocated": colocated,
+        **_stage_timings(),
     }
     print(json.dumps(out), flush=True)
 
@@ -644,6 +666,7 @@ def run_features(machines: int, rounds: int) -> dict:
             cpu_request=100, ram_request=1 << 18, gang=True,
         ))
     planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+    stagetimer.reset()
     t0 = time.perf_counter()
     _, mg = planner.schedule_round()
     gang_s = time.perf_counter() - t0
@@ -667,6 +690,7 @@ def run_features(machines: int, rounds: int) -> dict:
         "placed_gangs": placed_gangs,
         "partial_gangs": partial_gangs,
         "oversized_gang_placed": big_placed,
+        **_stage_timings(),
     }
     out["ok"] = (
         violations == 0

@@ -9,9 +9,15 @@ enough to fuse (the CPU/Mem model's arithmetic is all broadcastable).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import TYPE_CHECKING, Dict, List, Optional, Tuple
 
 import numpy as np
+
+if TYPE_CHECKING:  # annotation-only: no graph <-> costmodel import cycle
+    from poseidon_tpu.graph.residency import (
+        MachineLabelIndex,
+        ResidentCounts,
+    )
 
 # The normalized cost range models map into.  Must stay well under the
 # solver's COST_CAP (1 << 14) including the unscheduled multiple.
@@ -86,12 +92,15 @@ class MachineTable:
     # penalty vectors (devil, rabbit, sheep, turtle).
     type_census: Optional[np.ndarray] = None       # int64 [M, 4]
     coco_penalties: Optional[np.ndarray] = None    # int64 [M, 4]
-    # Resident-task label aggregates for pod-level affinity: per machine,
-    # (key, value) -> count, key -> count, and total resident tasks.
-    # None when no pending task carries pod selectors (skip the pass).
-    resident_kv: Optional[List[Dict[Tuple[str, str], int]]] = None
-    resident_key: Optional[List[Dict[str, int]]] = None
-    resident_total: Optional[np.ndarray] = None    # int64 [M]
+    # Resident-task label aggregates for pod-level affinity: the round's
+    # view of the incrementally-maintained interned count matrices
+    # (graph/residency.ResidentCounts — [M, K] counts + totals, machine-
+    # column order).  None when no pending task carries pod selectors.
+    residents: Optional["ResidentCounts"] = None
+    # Interned machine labels for node-selector admissibility, cached
+    # across rounds by node generation (graph/state).  None falls back
+    # to the per-machine probe engine.
+    label_index: Optional["MachineLabelIndex"] = None
     # Observed committed load: like cpu_used/ram_used but with each
     # resident's reservation replaced by its knowledge-base usage EMA
     # (AddTaskStats history) when one exists.  None when the task KB is

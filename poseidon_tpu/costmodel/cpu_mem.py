@@ -35,7 +35,8 @@ from poseidon_tpu.costmodel.selectors import (
     pod_selector_admissibility,
     selector_admissibility,
 )
-from poseidon_tpu.ops.transport import INF_COST
+from poseidon_tpu.ops.transport import INF_COST, sparse_adm_cells
+from poseidon_tpu.utils.stagetimer import stage as _stage
 
 
 @base.register
@@ -88,34 +89,69 @@ class CpuMemCostModel(base.CostModel):
         )[None, :]
         fits = (cpu_req <= cpu_free) & (ram_req <= ram_free)
 
-        admissible = fits & selector_admissibility(
-            ecs.selectors, machines.labels
-        )
-        if (
-            machines.resident_kv is not None
-            and ecs.pod_affinity is not None
-        ):
-            admissible &= pod_selector_admissibility(
-                ecs.pod_affinity, ecs.pod_anti_affinity, ecs.labels,
-                machines.resident_kv, machines.resident_key,
-                machines.resident_total,
+        with _stage("round.mask_build"):
+            constraint_mask = selector_admissibility(
+                ecs.selectors, machines.labels, machines.label_index
             )
+            if (
+                machines.residents is not None
+                and ecs.pod_affinity is not None
+            ):
+                constraint_mask &= pod_selector_admissibility(
+                    ecs.pod_affinity, ecs.pod_anti_affinity, ecs.labels,
+                    machines.residents,
+                )
+        admissible = fits & constraint_mask
+
+        # Heavily-constrained rounds (pod affinity pinning each EC to a
+        # handful of machines) leave a vanishing admissible fraction of
+        # a large [E, M] plane: compute the per-arc capacity and cost
+        # surfaces ONLY at admissible cells then (identical float64
+        # arithmetic in the same operation order, so the result is
+        # bit-identical to the dense build).  Dense rounds keep the
+        # full-matrix broadcasts below.
+        sparse_cells = sparse_adm_cells(admissible)
 
         # Per-arc capacity: how many tasks of EC e fit machine m's free
         # resources simultaneously (min over dimensions).  This is the
         # flow network's multi-dimensional packing bound.
-        with np.errstate(divide="ignore", invalid="ignore"):
-            n_cpu = np.where(
-                cpu_req > 0, np.floor(cpu_free / np.maximum(cpu_req, 1e-9)),
-                np.inf,
-            )
-            n_ram = np.where(
-                ram_req > 0, np.floor(ram_free / np.maximum(ram_req, 1e-9)),
-                np.inf,
-            )
-        n_fit = np.minimum(n_cpu, n_ram)
-        n_fit = np.where(np.isfinite(n_fit), n_fit, np.iinfo(np.int32).max // 4)
-        arc_cap = np.where(admissible, n_fit, 0).astype(np.int32)
+        big_fit = np.iinfo(np.int32).max // 4
+        if sparse_cells is not None:
+            rows, cols = sparse_cells
+            cpu_req_v = cpu_req[rows, 0]
+            ram_req_v = ram_req[rows, 0]
+            cpu_free_v = cpu_free[0, cols]
+            ram_free_v = ram_free[0, cols]
+            with np.errstate(divide="ignore", invalid="ignore"):
+                n_cpu_v = np.where(
+                    cpu_req_v > 0,
+                    np.floor(cpu_free_v / np.maximum(cpu_req_v, 1e-9)),
+                    np.inf,
+                )
+                n_ram_v = np.where(
+                    ram_req_v > 0,
+                    np.floor(ram_free_v / np.maximum(ram_req_v, 1e-9)),
+                    np.inf,
+                )
+            n_fit_v = np.minimum(n_cpu_v, n_ram_v)
+            n_fit_v = np.where(np.isfinite(n_fit_v), n_fit_v, big_fit)
+            arc_cap = np.zeros((E, M), dtype=np.int32)
+            arc_cap[rows, cols] = n_fit_v.astype(np.int32)
+        else:
+            with np.errstate(divide="ignore", invalid="ignore"):
+                n_cpu = np.where(
+                    cpu_req > 0,
+                    np.floor(cpu_free / np.maximum(cpu_req, 1e-9)),
+                    np.inf,
+                )
+                n_ram = np.where(
+                    ram_req > 0,
+                    np.floor(ram_free / np.maximum(ram_req, 1e-9)),
+                    np.inf,
+                )
+            n_fit = np.minimum(n_cpu, n_ram)
+            n_fit = np.where(np.isfinite(n_fit), n_fit, big_fit)
+            arc_cap = np.where(admissible, n_fit, 0).astype(np.int32)
 
         # Anti-affinity to self = spreading: members of such an EC cannot
         # co-locate, so each machine takes at most one per round (running
@@ -141,20 +177,43 @@ class CpuMemCostModel(base.CostModel):
             if machines.ram_obs_used is not None else machines.ram_used
         )
         w = float(self.measured_weight)
-        cpu_load = (
-            (1.0 - w) * (cpu_committed[None, :] + cpu_req) / cpu_cap[None, :]
-            + w * machines.cpu_util.astype(np.float64)[None, :]
-        )
-        mem_load = (
-            (1.0 - w) * (ram_committed[None, :] + ram_req) / ram_cap[None, :]
-            + w * machines.mem_util.astype(np.float64)[None, :]
-        )
         wc = float(self.cpu_weight)
-        load = wc * cpu_load + (1.0 - wc) * mem_load
-        costs = np.clip(
-            np.rint(load * base.NORMALIZED_COST), 0, 4 * base.NORMALIZED_COST
-        ).astype(np.int32)
-        costs = np.where(admissible, costs, INF_COST).astype(np.int32)
+        if sparse_cells is not None:
+            cpu_load_v = (
+                (1.0 - w)
+                * (cpu_committed.astype(np.float64)[cols] + cpu_req_v)
+                / cpu_cap[cols]
+                + w * machines.cpu_util.astype(np.float64)[cols]
+            )
+            mem_load_v = (
+                (1.0 - w)
+                * (ram_committed.astype(np.float64)[cols] + ram_req_v)
+                / ram_cap[cols]
+                + w * machines.mem_util.astype(np.float64)[cols]
+            )
+            load_v = wc * cpu_load_v + (1.0 - wc) * mem_load_v
+            costs = np.full((E, M), INF_COST, dtype=np.int32)
+            costs[rows, cols] = np.clip(
+                np.rint(load_v * base.NORMALIZED_COST),
+                0, 4 * base.NORMALIZED_COST,
+            ).astype(np.int32)
+        else:
+            cpu_load = (
+                (1.0 - w)
+                * (cpu_committed[None, :] + cpu_req) / cpu_cap[None, :]
+                + w * machines.cpu_util.astype(np.float64)[None, :]
+            )
+            mem_load = (
+                (1.0 - w)
+                * (ram_committed[None, :] + ram_req) / ram_cap[None, :]
+                + w * machines.mem_util.astype(np.float64)[None, :]
+            )
+            load = wc * cpu_load + (1.0 - wc) * mem_load
+            costs = np.clip(
+                np.rint(load * base.NORMALIZED_COST),
+                0, 4 * base.NORMALIZED_COST,
+            ).astype(np.int32)
+            costs = np.where(admissible, costs, INF_COST).astype(np.int32)
 
         return base.CostMatrices(
             costs=costs,

@@ -24,7 +24,8 @@ denominator of ``graph/instance.py:_solve_banded``:
   placement choices can differ from the host build by cost ties only.
 
 The admissibility mask (selectors, pod (anti-)affinity vs resident
-tasks) stays HOST-computed: it is label-set logic over Python dicts,
+tasks) stays HOST-computed: it is vectorized label-set logic over the
+interned label/resident count matrices (costmodel/selectors.py),
 F_A-independent, and ships as one [E, M] int8 plane.
 """
 
@@ -60,11 +61,13 @@ def extract_band_operands(ecs_b, mt, model) -> dict:
     )
     unsched = np.clip(unsched, 0, 8 * base.NORMALIZED_COST).astype(np.int32)
 
-    adm0 = selector_admissibility(ecs_b.selectors, mt.labels)
-    if mt.resident_kv is not None and ecs_b.pod_affinity is not None:
+    adm0 = selector_admissibility(
+        ecs_b.selectors, mt.labels, mt.label_index
+    )
+    if mt.residents is not None and ecs_b.pod_affinity is not None:
         adm0 = adm0 & pod_selector_admissibility(
             ecs_b.pod_affinity, ecs_b.pod_anti_affinity, ecs_b.labels,
-            mt.resident_kv, mt.resident_key, mt.resident_total,
+            mt.residents,
         )
     anti_self = np.zeros(E, dtype=bool)
     if ecs_b.pod_anti_affinity is not None and ecs_b.labels is not None:

@@ -37,6 +37,16 @@ def ec_signature(
     giving each gang its own EC row so all-or-nothing placement is a
     per-row property of the flow solution (the flow-gadget analog of
     Firmament's job-level min-flow requirements).
+
+    Pod-level (anti-)affinity selectors DO partition ECs (the caller
+    prefixes them into ``selectors`` — see TaskInfo.compute_ec_id), but
+    task labels still don't: the constraint-mask engine evaluates the
+    self-satisfying bootstrap rule against the EC's *representative*
+    member's labels, so co-EC tasks whose labels differ in ways a
+    shared pod selector can see would bootstrap incorrectly.  In
+    practice the watcher derives pod selectors from the same label
+    vocabulary, so selector-identical tasks are label-compatible; keep
+    that invariant if a new ingest path mints pod selectors.
     """
     h = fnv64a("ec")
     h = hash_combine(h, int(cpu_request))

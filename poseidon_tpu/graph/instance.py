@@ -33,7 +33,11 @@ log = logging.getLogger("poseidon_tpu.planner")
 
 from poseidon_tpu.costmodel.base import CostModel
 from poseidon_tpu.graph.state import ClusterState
-from poseidon_tpu.ops.transport import INF_COST, solve_transport
+from poseidon_tpu.ops.transport import (
+    INF_COST,
+    solve_transport,
+    sparse_adm_cells,
+)
 from poseidon_tpu.utils.stagetimer import stage as _stage
 
 
@@ -200,13 +204,25 @@ def _column_caps(ecs_b, cm, mt, committed_cpu, committed_ram,
     per-band loop and the chained wave path (its device twin is
     costmodel.device_build)."""
     adm = cm.costs < INF_COST                      # [E_b, M]
+    M = adm.shape[1]
+    # Sparse-admissibility rounds (each EC pinned to a few machines):
+    # the per-column max over a near-empty plane is a scatter-max over
+    # the admissible cells, not three full [E, M] passes.
+    cells = sparse_adm_cells(adm)
+
+    def col_denom(req) -> np.ndarray:
+        if cells is not None:
+            denom = np.zeros(M, dtype=np.int64)
+            np.maximum.at(denom, cells[1], req.astype(np.int64)[cells[0]])
+            return denom
+        return np.where(adm, req.astype(np.int64)[:, None], 0).max(axis=0)
+
     col_cap = cm.capacity.astype(np.int64)
     for req, cap_arr, used in (
         (ecs_b.cpu_request, mt.cpu_capacity, committed_cpu),
         (ecs_b.ram_request, mt.ram_capacity, committed_ram),
     ):
-        denom = np.where(adm, req.astype(np.int64)[:, None], 0)
-        denom = denom.max(axis=0)                   # [M]
+        denom = col_denom(req)                      # [M]
         free = np.maximum(cap_arr.astype(np.int64) - used, 0)
         col_cap = np.where(
             denom > 0,
@@ -216,9 +232,7 @@ def _column_caps(ecs_b, cm, mt, committed_cpu, committed_ram,
     net_req = ecs_b.net_rx()
     if mt.net_rx_capacity is not None:
         raw = mt.net_rx_capacity.astype(np.int64)
-        denom = np.where(
-            adm, net_req.astype(np.int64)[:, None], 0
-        ).max(axis=0)
+        denom = col_denom(net_req)
         free = np.maximum(raw - committed_net, 0)
         col_cap = np.where(
             (raw > 0) & (denom > 0),
@@ -1031,8 +1045,9 @@ class RoundPlanner:
         """Single-dispatch two-band wave (ops/transport_chained), or
         None to fall through to the per-band loop.
 
-        Gates: chain_gate() (accelerator default ON; POSEIDON_CHAINED
-        forces 1/0), single device, auction solver, cpu_mem model
+        Gates: chain_gate() (opt-in via POSEIDON_CHAINED=1, default OFF
+        everywhere pending the live A/B — see its docstring for the
+        measured trade), single device, auction solver, cpu_mem model
         without real net bounds, no gang rows, exactly two band GROUPS
         under the base-committed grouping gate, and no usable warm
         frame for either group (fresh-wave territory — warm churn

@@ -8,6 +8,12 @@ their placements and wait counters, machines with capacities/stat hooks,
 the round index — serializes to a single JSON document, so a restarted
 service resumes with placements intact even before the client re-plays
 its world (the re-play then lands on ALREADY_* replies as usual).
+
+Derived state is NOT serialized: the constraint-mask engine's resident
+count matrices (graph/residency.py) and the machine-label interning
+cache rebuild through the same mutators ``load_state`` drives
+(task_submitted / apply_placements / node_added), so the checkpoint
+format stays a pure record of the cluster facts.
 """
 
 from __future__ import annotations

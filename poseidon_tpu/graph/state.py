@@ -29,6 +29,10 @@ from typing import Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from poseidon_tpu.graph.ecs import Selector, ec_signature
+from poseidon_tpu.graph.residency import (
+    MachineLabelIndex,
+    ResidentLabelIndex,
+)
 
 
 class TaskReply(enum.IntEnum):
@@ -225,8 +229,20 @@ class ClusterState:
         # skip rebuild work on quiet rounds.
         self.generation = 0
         # Live count of tasks carrying pod-level (anti-)affinity: the
-        # per-round resident-label pass is skipped entirely when zero.
+        # resident-label machinery is inert while zero.
         self._pod_selector_tasks = 0
+        # Incrementally-maintained resident-label count matrices (the
+        # constraint-mask engine's state half).  Activated — one
+        # O(tasks) rebuild — the first round that actually carries pod
+        # selectors; from then on every placement/completion/PREEMPT
+        # updates it by deltas, and build_round_view hands cost models
+        # an O(M)-gather view instead of re-scanning every task.
+        self._residency = ResidentLabelIndex()
+        # Node-mutation generation + the machine-label interning cache
+        # it keys: rounds with unchanged nodes reuse the interned
+        # selector-admissibility index instead of re-interning labels.
+        self._node_generation = 0
+        self._label_cache: Optional[Tuple[int, MachineLabelIndex]] = None
         # Resubmission affinity: machine a REMOVED task was running on,
         # keyed by uid.  Steady-state churn removes and resubmits the
         # same work (reference controllers recreate pods; the bench's 1%
@@ -282,6 +298,9 @@ class ClusterState:
             self.jobs.setdefault(task.job_id, set()).add(task.uid)
             if task.pod_affinity or task.pod_anti_affinity:
                 self._pod_selector_tasks += 1
+            if self._residency.active and task.scheduled_to is not None:
+                # Carried binding (restart recovery): resident on arrival.
+                self._residency.add(task.scheduled_to, task.labels)
             if self._native is not None:
                 self._native.task_submit(
                     task.uid, task.ec_id, task.cpu_request,
@@ -298,6 +317,8 @@ class ClusterState:
         task = self.tasks.get(uid)
         if task is None:
             return None
+        if self._residency.active and task.scheduled_to is not None:
+            self._residency.remove(task.scheduled_to, task.labels)
         task.state = state
         task.scheduled_to = None
         if self._native is not None:
@@ -320,6 +341,8 @@ class ClusterState:
             # as a *new* task (the reference's controller recreates the pod
             # and the watcher derives a fresh uid, podwatcher.go:310-318);
             # the failed task itself is later TaskRemoved.
+            if self._residency.active and task.scheduled_to is not None:
+                self._residency.remove(task.scheduled_to, task.labels)
             task.state = TaskState.FAILED
             task.scheduled_to = None
             if self._native is not None:
@@ -341,6 +364,13 @@ class ClusterState:
                     )
             if task.pod_affinity or task.pod_anti_affinity:
                 self._pod_selector_tasks -= 1
+            if self._residency.active:
+                if task.scheduled_to is not None:
+                    self._residency.remove(task.scheduled_to, task.labels)
+                if self._pod_selector_tasks == 0:
+                    # Last pod-selector task gone: stop paying the
+                    # per-mutation maintenance (re-activation rebuilds).
+                    self._residency.deactivate()
             if self._native is not None:
                 self._native.task_remove(uid)
             members = self.jobs.get(task.job_id)
@@ -365,6 +395,18 @@ class ClusterState:
             existing.priority = task.priority
             existing.task_type = task.task_type
             had = bool(existing.pod_affinity or existing.pod_anti_affinity)
+            if (
+                self._residency.active
+                and existing.scheduled_to is not None
+                and task.labels != existing.labels
+            ):
+                # A resident's labels changed in place: the count
+                # matrices must follow (the old per-round rebuild picked
+                # this up for free; the incremental index needs the
+                # delta).
+                self._residency.relabel(
+                    existing.scheduled_to, existing.labels, task.labels
+                )
             existing.selectors = task.selectors
             existing.pod_affinity = task.pod_affinity
             existing.pod_anti_affinity = task.pod_anti_affinity
@@ -372,6 +414,10 @@ class ClusterState:
             existing.ec_id = existing.compute_ec_id()
             has = bool(existing.pod_affinity or existing.pod_anti_affinity)
             self._pod_selector_tasks += int(has) - int(had)
+            if (
+                self._residency.active and self._pod_selector_tasks == 0
+            ):
+                self._residency.deactivate()
             if self._native is not None:
                 self._native.task_update(
                     existing.uid, existing.ec_id, existing.cpu_request,
@@ -399,13 +445,17 @@ class ClusterState:
                     machine.ram_capacity, machine.net_rx_capacity,
                     machine.task_slots,
                 )
+            self._node_generation += 1
             self.generation += 1
             return NodeReply.ADDED_OK
 
     def _evict_tasks_on(self, machine_uuid: str) -> List[int]:
         evicted = []
+        res_active = self._residency.active
         for task in self.tasks.values():
             if task.scheduled_to == machine_uuid:
+                if res_active:
+                    self._residency.remove(machine_uuid, task.labels)
                 task.scheduled_to = None
                 task.state = TaskState.RUNNABLE
                 if self._native is not None:
@@ -428,6 +478,7 @@ class ClusterState:
             # Tasks on a failed node go back to runnable; the next round
             # re-places them (failure propagation, nodewatcher.go:151-165).
             self._evict_tasks_on(machine.uuid)
+            self._node_generation += 1
             self.generation += 1
             return NodeReply.FAILED_OK
 
@@ -444,8 +495,12 @@ class ClusterState:
                 self.resource_to_machine.pop(sub, None)
             self.node_kb.pop(machine.uuid, None)
             self._evict_tasks_on(machine.uuid)
+            if self._residency.active:
+                # Row recycled only after eviction drained its counts.
+                self._residency.machine_removed(machine.uuid)
             if self._native is not None:
                 self._native.machine_remove(self._nkey(machine.uuid))
+            self._node_generation += 1
             self.generation += 1
             return NodeReply.REMOVED_OK
 
@@ -475,6 +530,7 @@ class ClusterState:
             for sub in sorted(machine.subtree_uuids):
                 existing.subtree_uuids.add(sub)
                 self.resource_to_machine[sub] = existing.uuid
+            self._node_generation += 1
             self.generation += 1
             return NodeReply.UPDATED_OK
 
@@ -546,11 +602,33 @@ class ClusterState:
         uids_append = native_uids.append
         keys_append = native_keys.append
         runnable, running = TaskState.RUNNABLE, TaskState.RUNNING
+        res_dec: List[int] = []
+        res_inc: List[int] = []
         with self._lock:
+            # Residency deltas (None while the mask engine is inactive —
+            # the common no-affinity wave pays one attribute check).
+            # Label-less transitions batch into two scatter-adds;
+            # labelled ones (the affinity workloads, a few thousand)
+            # update inline.  Read under the lock: activation /
+            # deactivation happen on other service threads.
+            res = self._residency if self._residency.active else None
             for uid, machine_uuid in placements:
                 task = tasks_get(uid)
                 if task is None:
                     continue
+                if res is not None:
+                    old = task.scheduled_to
+                    if old != machine_uuid:
+                        if task.labels:
+                            if old is not None:
+                                res.remove(old, task.labels)
+                            if machine_uuid is not None:
+                                res.add(machine_uuid, task.labels)
+                        else:
+                            if old is not None:
+                                res_dec.append(res.row(old))
+                            if machine_uuid is not None:
+                                res_inc.append(res.row(machine_uuid))
                 task.scheduled_to = machine_uuid
                 if machine_uuid is None:
                     task.state = runnable
@@ -569,10 +647,43 @@ class ClusterState:
                     np.asarray(native_uids, dtype=np.uint64),
                     np.asarray(native_keys, dtype=np.uint64),
                 )
+            if res is not None:
+                res.bump_totals(res_dec, res_inc)
             if applied:
                 # No-op batches leave the generation untouched so quiet
                 # rounds stay recognizable to the incremental fast path.
                 self.generation += 1
+
+    # ------------------------------------------------- constraint-mask state
+
+    def _round_residents(self, machines):
+        """The round's ResidentCounts view (or None when no pending task
+        carries pod selectors).  First use activates the incremental
+        index with one O(tasks) rebuild; every later round is an O(M)
+        row gather of the delta-maintained matrices.  Caller holds the
+        lock."""
+        if self._pod_selector_tasks <= 0:
+            return None
+        res = self._residency
+        if not res.active:
+            res.activate()
+            for t in self.tasks.values():
+                if t.scheduled_to is not None:
+                    res.add(t.scheduled_to, t.labels)
+        return res.view([m.uuid for m in machines])
+
+    def _machine_label_index(self, machines) -> MachineLabelIndex:
+        """Interned machine labels for selector admissibility, cached
+        across rounds keyed on the node generation (any node add /
+        remove / fail / update invalidates — those are the only
+        mutations that can change the machine column set or its
+        labels).  Caller holds the lock."""
+        cached = self._label_cache
+        if cached is not None and cached[0] == self._node_generation:
+            return cached[1]
+        index = MachineLabelIndex.build([m.labels for m in machines])
+        self._label_cache = (self._node_generation, index)
+        return index
 
     @staticmethod
     def _observed_class(task, entry) -> int:
@@ -670,15 +781,11 @@ class ClusterState:
             cpu_used = np.zeros(len(machines), dtype=np.int64)
             ram_used = np.zeros(len(machines), dtype=np.int64)
             slots_used = np.zeros(len(machines), dtype=np.int32)
-            # Resident-label aggregates for pod-level affinity; collected
-            # only when some live task actually carries pod selectors.
-            collect_labels = self._pod_selector_tasks > 0
-            res_kv = [dict() for _ in machines] if collect_labels else None
-            res_key = [dict() for _ in machines] if collect_labels else None
-            res_total = (
-                np.zeros(len(machines), dtype=np.int64)
-                if collect_labels else None
-            )
+            # Resident-label aggregates for pod-level affinity: the
+            # incrementally-maintained interned count matrices, gathered
+            # into this round's machine-column order (None when no
+            # pending task carries pod selectors).
+            residents = self._round_residents(machines)
 
             schedulable = (
                 (TaskState.RUNNABLE, TaskState.RUNNING)
@@ -699,13 +806,6 @@ class ClusterState:
                         cpu_used[cur] += t.cpu_request
                         ram_used[cur] += t.ram_request
                         slots_used[cur] += 1
-                    if collect_labels:
-                        res_total[cur] += 1
-                        kv = res_kv[cur]
-                        kk = res_key[cur]
-                        for k, v in t.labels.items():
-                            kv[(k, v)] = kv.get((k, v), 0) + 1
-                            kk[k] = kk.get(k, 0) + 1
                 if t.state not in schedulable:
                     continue
                 g = groups.get(t.ec_id)
@@ -815,9 +915,8 @@ class ClusterState:
                     ],
                     dtype=np.int64,
                 ),
-                resident_kv=res_kv,
-                resident_key=res_key,
-                resident_total=res_total,
+                residents=residents,
+                label_index=self._machine_label_index(machines),
                 cpu_obs_used=cpu_obs,
                 ram_obs_used=ram_obs,
             )
@@ -870,28 +969,11 @@ class ClusterState:
                     )
                 rep_list.append(self.tasks[int(uids[o])])
 
-            # Resident-label aggregates (pod-level affinity) stay a
-            # Python pass — only when some live task carries pod
-            # selectors; labels never cross the native boundary.
-            res_kv = res_key = res_total = None
-            if self._pod_selector_tasks > 0:
-                uuid_to_col = {m.uuid: j for j, m in enumerate(machines)}
-                res_kv = [dict() for _ in machines]
-                res_key = [dict() for _ in machines]
-                res_total = np.zeros(M, dtype=np.int64)
-                for t in self.tasks.values():
-                    if t.state not in (TaskState.RUNNABLE, TaskState.RUNNING):
-                        continue
-                    col = uuid_to_col.get(t.scheduled_to, -1) \
-                        if t.scheduled_to else -1
-                    if col < 0:
-                        continue
-                    res_total[col] += 1
-                    kv = res_kv[col]
-                    kk = res_key[col]
-                    for k, v in t.labels.items():
-                        kv[(k, v)] = kv.get((k, v), 0) + 1
-                        kk[k] = kk.get(k, 0) + 1
+            # Resident-label aggregates (pod-level affinity): the same
+            # incremental interned matrices as the Python path — labels
+            # never cross the native boundary, and the O(tasks) label
+            # re-scan this path used to pay per round is gone.
+            residents = self._round_residents(machines)
 
             # Descriptor-carried Whare-Map census on top of the live one.
             for j, m in enumerate(machines):
@@ -963,9 +1045,8 @@ class ClusterState:
                     ],
                     dtype=np.int64,
                 ),
-                resident_kv=res_kv,
-                resident_key=res_key,
-                resident_total=res_total,
+                residents=residents,
+                label_index=self._machine_label_index(machines),
                 cpu_obs_used=cpu_obs,
                 ram_obs_used=ram_obs,
             )

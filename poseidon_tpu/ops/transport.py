@@ -1312,13 +1312,9 @@ def maybe_greedy_start(greedy_init, init_flows, init_prices, init_unsched,
                                       e_pad, m_pad)
     if scale is None:
         scale = d_scale
-    C64 = costs.astype(np.int64)
-    used = init_flows > 0
-    marginal = np.where(used, C64, -1).max(axis=1)          # [E]
-    marginal = np.where(leftover > 0, unsched_cost.astype(np.int64),
-                        marginal)
-    marginal = np.clip(marginal, 0, None)
-
+    BIG = np.int64(1) << 60
+    sup64 = supply.astype(np.int64)
+    cap64 = capacity.astype(np.int64)
     # Machine potentials: a column whose residual arcs undercut row
     # marginals (a machine freed below the fill frontier) prices down by
     # that demand, bounded by the slack of its own loaded arcs (a loaded
@@ -1326,15 +1322,7 @@ def maybe_greedy_start(greedy_init, init_flows, init_prices, init_unsched,
     # column-structured part of the gap — after a churn round the freed
     # machines are cheaper than the frontier for EVERY row, which no
     # row-potential choice can express.
-    adm = costs < INF_COST
-    Uem = np.minimum(supply.astype(np.int64)[:, None],
-                     capacity.astype(np.int64)[None, :])
-    if arc_capacity is not None:
-        Uem = np.minimum(Uem, arc_capacity.astype(np.int64))
-    resid = adm & (Uem - init_flows > 0)
-    BIG = np.int64(1) << 60
-    Cs = np.where(adm, C64 * scale, BIG)
-    has_flow = used.any(axis=1)
+    #
     # A few rounds of alternation toward equilibrium duals.  Per column,
     # eps-feasibility is the interval  max_loaded(Cs+pe) <= pm <=
     # min_resid(Cs+pe): loaded arcs need rc = Cs+pe-pm <= 0, residual
@@ -1345,23 +1333,76 @@ def maybe_greedy_start(greedy_init, init_flows, init_prices, init_unsched,
     # Conflicting intervals (true contention) keep the loaded bound;
     # the residual violation is then exactly what the certificate and
     # the epsilon ladder resolve.
-    pm0 = np.zeros(M, dtype=np.int64)
-    pe0 = -scale * marginal
-    for _ in range(2):
-        q = Cs + pe0[:, None]                         # [E, M]
-        lo = np.where(used, q, -BIG).max(axis=0)      # loaded bound
-        hi = np.where(resid, q, BIG).min(axis=0)      # residual bound
-        # (Dead columns fall out as max(-BIG, min(BIG, 0)) = 0.)
-        pm0 = np.maximum(lo, np.minimum(hi, 0))
-        # Row utility: best net cost among its loaded arcs (rows without
-        # flow keep their greedy/fallback marginal).
-        net = np.where(used, Cs - pm0[None, :], BIG).min(axis=1)
-        pe0 = np.where(has_flow, -net, -scale * marginal)
+    #
+    # Two evaluation engines, identical arithmetic: gathered per-
+    # admissible-arc reductions when admissibility is sparse (the
+    # constrained rounds whose full-width passes used to dominate the
+    # round), full-matrix numpy otherwise.  Loaded and residual arcs
+    # are both subsets of the admissible set, so the sparse reductions
+    # see every cell the dense masks select.
+    sp = _adm_nonzero(costs)
+    if sp is not None:
+        r, c = sp
+        C64_v = costs[r, c].astype(np.int64)
+        fl_v = init_flows[r, c].astype(np.int64)
+        used_v = fl_v > 0
+        ru, cu = r[used_v], c[used_v]
+        marginal = np.full(E, -1, dtype=np.int64)
+        np.maximum.at(marginal, ru, C64_v[used_v])
+        marginal = np.where(leftover > 0, unsched_cost.astype(np.int64),
+                            marginal)
+        marginal = np.clip(marginal, 0, None)
+        uem_v = np.minimum(sup64[r], cap64[c])
+        if arc_capacity is not None:
+            uem_v = np.minimum(uem_v, arc_capacity[r, c].astype(np.int64))
+        resid_v = uem_v - fl_v > 0
+        rr, cr = r[resid_v], c[resid_v]
+        Cs_u = C64_v[used_v] * scale
+        Cs_r = C64_v[resid_v] * scale
+        has_flow = np.zeros(E, dtype=bool)
+        has_flow[ru] = True
+        pm0 = np.zeros(M, dtype=np.int64)
+        pe0 = -scale * marginal
+        for _ in range(2):
+            lo = np.full(M, -BIG, dtype=np.int64)     # loaded bound
+            np.maximum.at(lo, cu, Cs_u + pe0[ru])
+            hi = np.full(M, BIG, dtype=np.int64)      # residual bound
+            np.minimum.at(hi, cr, Cs_r + pe0[rr])
+            # (Dead columns fall out as max(-BIG, min(BIG, 0)) = 0.)
+            pm0 = np.maximum(lo, np.minimum(hi, 0))
+            net = np.full(E, BIG, dtype=np.int64)
+            np.minimum.at(net, ru, Cs_u - pm0[cu])
+            pe0 = np.where(has_flow, -net, -scale * marginal)
+    else:
+        C64 = costs.astype(np.int64)
+        used = init_flows > 0
+        marginal = np.where(used, C64, -1).max(axis=1)      # [E]
+        marginal = np.where(leftover > 0, unsched_cost.astype(np.int64),
+                            marginal)
+        marginal = np.clip(marginal, 0, None)
+        adm = costs < INF_COST
+        Uem = np.minimum(sup64[:, None], cap64[None, :])
+        if arc_capacity is not None:
+            Uem = np.minimum(Uem, arc_capacity.astype(np.int64))
+        resid = adm & (Uem - init_flows > 0)
+        Cs = np.where(adm, C64 * scale, BIG)
+        has_flow = used.any(axis=1)
+        pm0 = np.zeros(M, dtype=np.int64)
+        pe0 = -scale * marginal
+        for _ in range(2):
+            q = Cs + pe0[:, None]                         # [E, M]
+            lo = np.where(used, q, -BIG).max(axis=0)      # loaded bound
+            hi = np.where(resid, q, BIG).min(axis=0)      # residual bound
+            pm0 = np.maximum(lo, np.minimum(hi, 0))
+            # Row utility: best net cost among its loaded arcs (rows
+            # without flow keep their greedy/fallback marginal).
+            net = np.where(used, Cs - pm0[None, :], BIG).min(axis=1)
+            pe0 = np.where(has_flow, -net, -scale * marginal)
     pm0 = np.clip(pm0, -(PRICE_SPREAD_CAP - 1), PRICE_SPREAD_CAP - 1)
     pe0 = np.clip(pe0, -(PRICE_SPREAD_CAP - 1), PRICE_SPREAD_CAP - 1)
     # Sink potential: machines with spare sink capacity need
     # pm - pt >= -eps, so pt sits at their minimum.
-    spare = init_flows.sum(axis=0) < capacity.astype(np.int64)
+    spare = init_flows.sum(axis=0, dtype=np.int64) < cap64
     pt0 = int(pm0[spare].min(initial=0))
     init_prices = np.concatenate(
         [pe0, pm0, np.int64([pt0])]
@@ -1404,6 +1445,38 @@ def normalize_prices(p: np.ndarray) -> np.ndarray:
     return np.maximum(shifted, -PRICE_SPREAD_CAP).astype(np.int32)
 
 
+# Sparse-admissibility gate for the host-side O(E*M) helpers: gathered
+# (per-admissible-arc) evaluation replaces full-matrix passes only when
+# the matrix is large AND admissible arcs are a small minority — heavily
+# constrained rounds (pod affinity pinning each EC to a handful of
+# machines) at cluster scale.  Dense rounds keep the existing full-width
+# code paths untouched.
+_SPARSE_MIN_SIZE = 1 << 22
+_SPARSE_FACTOR = 16
+
+
+def sparse_adm_cells(adm: np.ndarray):
+    """``(rows, cols)`` of an admissibility mask when sparse (gathered)
+    evaluation pays, else None (callers run their dense path).  The one
+    definition of the gate — the cost build (costmodel/cpu_mem.py) and
+    the planner's column caps (graph/instance.py) share it, so retuning
+    the thresholds cannot leave the paths gated differently."""
+    if adm.size < _SPARSE_MIN_SIZE:
+        return None
+    if int(np.count_nonzero(adm)) * _SPARSE_FACTOR >= adm.size:
+        return None
+    return np.nonzero(adm)
+
+
+def _adm_nonzero(costs):
+    """``sparse_adm_cells`` over a cost matrix's admissible arcs.  One
+    bool pass + count — noise next to the full-matrix passes it saves
+    when it fires."""
+    if costs.size < _SPARSE_MIN_SIZE:
+        return None
+    return sparse_adm_cells(costs < INF_COST)
+
+
 def _certified_eps(flows, unsched, prices, *, costs, supply, capacity,
                    unsched_cost, scale, arc_capacity=None):
     """Smallest eps for which the final state is verifiably eps-optimal.
@@ -1412,27 +1485,47 @@ def _certified_eps(flows, unsched, prices, *, costs, supply, capacity,
     optimality certificate never *assumes* the kernel's invariants held —
     the relabel/global-update floor clamps can locally break
     eps-optimality in pathological states, and this check is what keeps
-    gap_bound honest regardless.  O(E*M) numpy, trivial next to the solve.
+    gap_bound honest regardless.  O(E*M) numpy (O(admissible arcs) on
+    sparse-admissibility rounds — same arithmetic on the same cells),
+    trivial next to the solve.
     """
     E, M = costs.shape
-    C = costs.astype(np.int64) * scale
     pe = prices[:E].astype(np.int64)
     pm = prices[E:E + M].astype(np.int64)
     pt = int(prices[E + M])
-    adm = costs < INF_COST
-    rc = C + pe[:, None] - pm[None, :]
-    Uem = np.minimum(supply.astype(np.int64)[:, None],
-                     capacity.astype(np.int64)[None, :])
-    if arc_capacity is not None:
-        Uem = np.minimum(Uem, arc_capacity.astype(np.int64))
-    fl = flows.astype(np.int64)
     worst = 0
-    fwd = adm & (Uem - fl > 0)
-    if fwd.any():
-        worst = max(worst, int(-(rc[fwd].min(initial=0))))
-    rev = adm & (fl > 0)
-    if rev.any():
-        worst = max(worst, int(rc[rev].max(initial=0)))
+    sp = _adm_nonzero(costs)
+    if sp is not None:
+        r, c = sp
+        rc_v = costs[r, c].astype(np.int64) * scale + pe[r] - pm[c]
+        uem_v = np.minimum(supply.astype(np.int64)[r],
+                           capacity.astype(np.int64)[c])
+        if arc_capacity is not None:
+            uem_v = np.minimum(uem_v, arc_capacity[r, c].astype(np.int64))
+        fl_v = flows[r, c].astype(np.int64)
+        fwd_v = uem_v - fl_v > 0
+        if fwd_v.any():
+            worst = max(worst, int(-(rc_v[fwd_v].min(initial=0))))
+        rev_v = fl_v > 0
+        if rev_v.any():
+            worst = max(worst, int(rc_v[rev_v].max(initial=0)))
+        fmt = flows.sum(axis=0, dtype=np.int64)
+    else:
+        C = costs.astype(np.int64) * scale
+        adm = costs < INF_COST
+        rc = C + pe[:, None] - pm[None, :]
+        Uem = np.minimum(supply.astype(np.int64)[:, None],
+                         capacity.astype(np.int64)[None, :])
+        if arc_capacity is not None:
+            Uem = np.minimum(Uem, arc_capacity.astype(np.int64))
+        fl = flows.astype(np.int64)
+        fwd = adm & (Uem - fl > 0)
+        if fwd.any():
+            worst = max(worst, int(-(rc[fwd].min(initial=0))))
+        rev = adm & (fl > 0)
+        if rev.any():
+            worst = max(worst, int(rc[rev].max(initial=0)))
+        fmt = fl.sum(axis=0)
     rc_fb = unsched_cost.astype(np.int64) * scale + pe - pt
     # Fallback forward residual: supply - Ffb; Ffb == unsched here.
     fb_resid = supply.astype(np.int64) - unsched.astype(np.int64) > 0
@@ -1442,7 +1535,6 @@ def _certified_eps(flows, unsched, prices, *, costs, supply, capacity,
     if fb_loaded.any():
         worst = max(worst, int(rc_fb[fb_loaded].max(initial=0)))
     # Machine->sink arcs (cost 0): Fmt == column sum at a clean exit.
-    fmt = fl.sum(axis=0)
     rc_mt = pm - pt
     mt_resid = capacity.astype(np.int64) - fmt > 0
     if mt_resid.any():
@@ -1501,12 +1593,22 @@ def _host_finalize(flows, unsched, prices, iters, *,
                 if excess == 0:
                     break
 
-    raw = costs.astype(np.int64)
-    raw[costs >= INF_COST] = 0  # inadmissible arcs never carry flow
-    objective = int(
-        (raw * flows.astype(np.int64)).sum()
-        + (unsched_cost.astype(np.int64) * unsched.astype(np.int64)).sum()
+    fb_cost = int(
+        (unsched_cost.astype(np.int64) * unsched.astype(np.int64)).sum()
     )
+    if costs.size >= _SPARSE_MIN_SIZE:
+        # Loaded arcs are a vanishing fraction of a large matrix: one
+        # nonzero scan + gather beats three full int64 passes.
+        nzr, nzc = np.nonzero(flows)
+        cost_v = costs[nzr, nzc].astype(np.int64)
+        cost_v[cost_v >= INF_COST] = 0  # inadmissible never carry flow
+        objective = int(
+            (cost_v * flows[nzr, nzc].astype(np.int64)).sum()
+        ) + fb_cost
+    else:
+        raw = costs.astype(np.int64)
+        raw[costs >= INF_COST] = 0
+        objective = int((raw * flows.astype(np.int64)).sum()) + fb_cost
     n = E + M + 3
     if not converged:
         gap_bound = float("inf")

@@ -221,7 +221,7 @@ def _chained_wave_device(
     reqA_ram = vecA[o:o + E1]; o += E1                    # noqa: E702
 
     (F1, fb1, prices1, it1, bf1, clean1, pi1,
-     itc1, _bfc1, _cc1, _eps1) = coarse_to_fine_band(
+     itc1, bfc1, _cc1, _eps1) = coarse_to_fine_band(
         bigA[0], bigA[1], capacityA, supplyA, unschedA, permA, invpermA,
         coarse3A[0], capgA, coarse3A[1], coarse3A[2], seedpA, seedfbA,
         epsschedA, eps_capA, mitA, geA, bfmaxA,
@@ -291,7 +291,7 @@ def _chained_wave_device(
         rungsB.append(jnp.maximum(rungsB[-1] // LADDER_FACTOR, 1))
     eps_sched_cB = jnp.stack(rungsB).astype(jnp.int32)
     (F2, fb2, prices2, it2, bf2, clean2, pi2,
-     itc2, _bfc2, _cc2, _eps2) = coarse_to_fine_band(
+     itc2, bfc2, _cc2, _eps2) = coarse_to_fine_band(
         costsB, arcB, colB, supplyB, unschedB, permB, invpermB,
         CgB, capgB, arcgB, seed_f, seed_p, seed_fb,
         eps_sched_cB, eps_capB, mitB, geB, bfmaxB,
@@ -302,12 +302,17 @@ def _chained_wave_device(
     # another; costsB (float-derived, not host-reproducible) rides as
     # the third and final fetch.
     flows = jnp.concatenate([F1, F2], axis=0)             # [E1+E2, M2]
+    # Iterations AND Bellman-Ford sweeps pack coarse+fine per band, so
+    # metrics.bf_sweeps accounts the chained path's true work like the
+    # fused path's coarse+full reporting (under-counting the coarse
+    # stage is the accounting artifact that nearly mis-decided the
+    # fused default — see instance.py counting_solve).
     small = jnp.concatenate([
         fb1.astype(jnp.int32), prices1.astype(jnp.int32),
-        jnp.stack([it1 + itc1, bf1, clean1]).astype(jnp.int32),
+        jnp.stack([it1 + itc1, bf1 + bfc1, clean1]).astype(jnp.int32),
         pi1.astype(jnp.int32),
         fb2.astype(jnp.int32), prices2.astype(jnp.int32),
-        jnp.stack([it2 + itc2, bf2, clean2]).astype(jnp.int32),
+        jnp.stack([it2 + itc2, bf2 + bfc2, clean2]).astype(jnp.int32),
         pi2.astype(jnp.int32),
         delta_cpu, delta_ram, delta_slots,
     ])
@@ -379,8 +384,13 @@ def solve_wave_chained(
         return None
     B = -(-m_pad // K)
     M2 = K * B
+    # BOTH bands run at this scale, and each band's exactness
+    # certificate (_host_finalize) needs scale > its rows + M + 3 —
+    # derive from the LARGER band's row padding, or a band-2-heavy wave
+    # (few big-task ECs, many small-task ECs) can never certify
+    # gap_bound == 0 and the chain silently declines every round.
     scale, max_raw_q = derive_scale(
-        costs1, unsched1, max_cost_hint, e1_pad, m_pad
+        costs1, unsched1, max_cost_hint, max(e1_pad, e2_pad), m_pad
     )
 
     # ---- band 1 padded operands (layout mirrors the fused path).
@@ -474,10 +484,29 @@ def solve_wave_chained(
     # Validation without a cost matrix: the device clips band-2 costs
     # to the model bound, so a [1,1] hint probe covers the range check;
     # supply/capacity (the flow-mass headroom inputs) are exact, and
-    # the scale is pinned explicitly.
+    # the scale is pinned explicitly.  The flow-mass guard runs against
+    # the REAL (unclipped) slot capacities — the device's column
+    # capacity is bounded by slots_free0, so an instance whose true
+    # slot sum breaks int32 flow arithmetic must decline here (the
+    # per-band fallback then raises the plain path's loud ValueError),
+    # not dispatch against a silently clipped bound.
+    cap2_real = pad_m(ops2["slots_free0"])
+    flow_mass2 = (
+        int(cap2_real.astype(np.int64).sum())
+        + int(supply2_p.astype(np.int64).sum())
+    )
+    if flow_mass2 >= (1 << 31):
+        import logging
+
+        logging.getLogger("poseidon_tpu.transport_chained").info(
+            "chained wave declined: band-2 flow mass %d >= 2^31 "
+            "(unclipped slot capacities); per-band path owns the round",
+            flow_mass2,
+        )
+        return None
     _host_validate(
         np.full((1, 1), min(int(max_cost_hint), COST_CAP), np.int32),
-        supply2_p, pad_m(np.minimum(ops2["slots_free0"], 1 << 20)),
+        supply2_p, cap2_real,
         opsB["unsched"], scale, None, max_cost_hint,
     )
     # Column sort from the BASE-LOAD proxy (M-vectors only): the

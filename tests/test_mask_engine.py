@@ -1,0 +1,385 @@
+"""Constraint-mask engine: interned/vectorized vs oracle parity, and
+incremental resident-count maintenance vs from-scratch rebuild.
+
+The vectorized engine (costmodel/selectors.pod_selector_admissibility
+over graph/residency.ResidentCounts; selector_admissibility over
+MachineLabelIndex) must be BIT-identical to the original per-machine
+dict-probe implementation, which is kept verbatim as the oracle
+(pod_selector_admissibility_dicts / the probe path of
+selector_admissibility).
+"""
+
+import numpy as np
+
+from poseidon_tpu.costmodel import get_cost_model
+from poseidon_tpu.costmodel.selectors import (
+    EXISTS_KEY,
+    IN_SET,
+    NOT_EXISTS_KEY,
+    NOT_IN_SET,
+    pod_selector_admissibility,
+    pod_selector_admissibility_dicts,
+    selector_admissibility,
+)
+from poseidon_tpu.graph.instance import RoundPlanner
+from poseidon_tpu.graph.residency import (
+    MachineLabelIndex,
+    ResidentLabelIndex,
+)
+from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+from poseidon_tpu.utils.ids import generate_uuid
+
+KEYS = ["app", "role", "tier", "ver"]
+VALUES = ["a", "b", "c", "d", "e"]
+ALL_TYPES = [IN_SET, NOT_IN_SET, EXISTS_KEY, NOT_EXISTS_KEY]
+
+
+def _random_selector(rng) -> tuple:
+    stype = ALL_TYPES[int(rng.integers(len(ALL_TYPES)))]
+    key = KEYS[int(rng.integers(len(KEYS)))]
+    if stype in (EXISTS_KEY, NOT_EXISTS_KEY):
+        return (stype, key, ())
+    n = int(rng.integers(1, 3))
+    vals = tuple(VALUES[int(rng.integers(len(VALUES)))] for _ in range(n))
+    return (stype, key, vals)
+
+
+def _random_labels(rng, p_empty=0.3) -> dict:
+    if rng.random() < p_empty:
+        return {}
+    n = int(rng.integers(1, 4))
+    picks = rng.choice(len(KEYS), size=n, replace=False)
+    return {
+        KEYS[int(k)]: VALUES[int(rng.integers(len(VALUES)))]
+        for k in picks
+    }
+
+
+def _dict_aggregates(machine_residents):
+    """Oracle-side aggregates from per-machine resident label lists."""
+    res_kv, res_key = [], []
+    res_total = np.zeros(len(machine_residents), dtype=np.int64)
+    for m, residents in enumerate(machine_residents):
+        kv, kk = {}, {}
+        for labels in residents:
+            for k, v in labels.items():
+                kv[(k, v)] = kv.get((k, v), 0) + 1
+                kk[k] = kk.get(k, 0) + 1
+        res_kv.append(kv)
+        res_key.append(kk)
+        res_total[m] = len(residents)
+    return res_kv, res_key, res_total
+
+
+def _index_view(machine_residents):
+    """Interned-engine view built from the same ground truth."""
+    idx = ResidentLabelIndex()
+    idx.activate()
+    uuids = [f"m{m}" for m in range(len(machine_residents))]
+    for u, residents in zip(uuids, machine_residents):
+        for labels in residents:
+            idx.add(u, labels)
+    return idx.view(uuids)
+
+
+class TestRandomizedParity:
+    def test_pod_mask_parity_randomized(self):
+        """All four selector types, the self-satisfying bootstrap rule,
+        and empty-resident machines, across 25 random instances: the
+        vectorized engine is bit-identical to the dict-probe oracle."""
+        rng = np.random.default_rng(seed=1234)
+        for trial in range(25):
+            M = int(rng.integers(1, 30))
+            E = int(rng.integers(1, 12))
+            # Some machines get zero residents; residents get random
+            # (often empty) label maps.
+            machine_residents = [
+                [_random_labels(rng)
+                 for _ in range(int(rng.integers(0, 5)))]
+                for _ in range(M)
+            ]
+            ec_aff, ec_anti, ec_labels = [], [], []
+            for _ in range(E):
+                ec_aff.append(tuple(
+                    _random_selector(rng)
+                    for _ in range(int(rng.integers(0, 3)))
+                ))
+                ec_anti.append(tuple(
+                    _random_selector(rng)
+                    for _ in range(int(rng.integers(0, 2)))
+                ))
+                # EC labels sometimes self-satisfy an affinity selector
+                # (the bootstrap rule's branch).
+                ec_labels.append(_random_labels(rng, p_empty=0.4))
+
+            res_kv, res_key, res_total = _dict_aggregates(machine_residents)
+            want = pod_selector_admissibility_dicts(
+                ec_aff, ec_anti, ec_labels, res_kv, res_key, res_total
+            )
+            got = pod_selector_admissibility(
+                ec_aff, ec_anti, ec_labels, _index_view(machine_residents)
+            )
+            np.testing.assert_array_equal(got, want, err_msg=f"{trial=}")
+
+    def test_machine_label_parity_randomized(self):
+        """Node-selector admissibility: interned index vs probe loop."""
+        rng = np.random.default_rng(seed=99)
+        for trial in range(25):
+            M = int(rng.integers(1, 40))
+            E = int(rng.integers(1, 10))
+            labels = [_random_labels(rng) for _ in range(M)]
+            sels = [
+                tuple(_random_selector(rng)
+                      for _ in range(int(rng.integers(0, 3))))
+                for _ in range(E)
+            ]
+            want = selector_admissibility(sels, labels)
+            got = selector_admissibility(
+                sels, labels, MachineLabelIndex.build(labels)
+            )
+            np.testing.assert_array_equal(got, want, err_msg=f"{trial=}")
+
+    def test_duplicate_values_not_double_counted(self):
+        """NOT_IN_SET with repeated values: the oracle sums over
+        set(values); the interned engine must dedupe columns the same
+        way or a single matching resident double-subtracts."""
+        residents = [[{"app": "a"}, {}]]  # one machine, 2 residents
+        sel = (NOT_IN_SET, "app", ("a", "a"))
+        res_kv, res_key, res_total = _dict_aggregates(residents)
+        want = pod_selector_admissibility_dicts(
+            [(sel,)], [()], [{}], res_kv, res_key, res_total
+        )
+        got = pod_selector_admissibility(
+            [(sel,)], [()], [{}], _index_view(residents)
+        )
+        np.testing.assert_array_equal(got, want)
+        assert want[0, 0]  # the label-less resident satisfies NOT_IN
+
+    def test_unknown_label_columns(self):
+        """Selectors naming labels no resident ever carried: IN/EXISTS
+        match nowhere, NOT_IN/NOT_EXISTS match wherever any resident
+        runs — on both engines."""
+        residents = [[{"app": "a"}], []]
+        view = _index_view(residents)
+        res_kv, res_key, res_total = _dict_aggregates(residents)
+        for sel in [
+            (IN_SET, "ghost", ("x",)),
+            (EXISTS_KEY, "ghost", ()),
+            (NOT_IN_SET, "ghost", ("x",)),
+            (NOT_EXISTS_KEY, "ghost", ()),
+        ]:
+            want = pod_selector_admissibility_dicts(
+                [(sel,)], [()], [{}], res_kv, res_key, res_total
+            )
+            got = pod_selector_admissibility([(sel,)], [()], [{}], view)
+            np.testing.assert_array_equal(got, want, err_msg=str(sel))
+
+
+def _rebuild_counts(state, uuids):
+    """From-scratch resident aggregates straight off task state — the
+    reference the incremental index must always equal."""
+    col = {u: j for j, u in enumerate(uuids)}
+    kv, kk = [{} for _ in uuids], [{} for _ in uuids]
+    total = np.zeros(len(uuids), dtype=np.int64)
+    for t in state.tasks.values():
+        if t.scheduled_to is None:
+            continue
+        j = col.get(t.scheduled_to)
+        if j is None:
+            continue
+        total[j] += 1
+        for k, v in t.labels.items():
+            kv[j][(k, v)] = kv[j].get((k, v), 0) + 1
+            kk[j][k] = kk[j].get(k, 0) + 1
+    return kv, kk, total
+
+
+def _assert_index_matches_rebuild(state):
+    uuids = sorted(state.machines)
+    want_kv, want_key, want_total = _rebuild_counts(state, uuids)
+    view = state._residency.view(uuids)
+    np.testing.assert_array_equal(view.total, want_total)
+    for j in range(len(uuids)):
+        got_kv = {
+            pair: int(view.kv_counts[j, c])
+            for pair, c in view.kv_id.items()
+            if c < view.kv_counts.shape[1] and view.kv_counts[j, c]
+        }
+        assert got_kv == want_kv[j], uuids[j]
+        got_key = {
+            k: int(view.key_counts[j, c])
+            for k, c in view.key_id.items()
+            if c < view.key_counts.shape[1] and view.key_counts[j, c]
+        }
+        assert got_key == want_key[j], uuids[j]
+
+
+class TestIncrementalMaintenance:
+    def test_interleaved_deltas_match_rebuild(self):
+        """Place / complete / preempt / migrate / relabel / fail /
+        node-remove deltas interleave; after every batch the maintained
+        counts equal a from-scratch rebuild."""
+        rng = np.random.default_rng(seed=7)
+        st = ClusterState(use_native=False)
+        uuids = []
+        for i in range(8):
+            u = generate_uuid(f"inc{i}")
+            uuids.append(u)
+            st.node_added(MachineInfo(
+                uuid=u, cpu_capacity=64000, ram_capacity=1 << 26,
+                task_slots=64,
+            ))
+        # One pod-selector task keeps the engine active throughout.
+        st.task_submitted(TaskInfo(
+            uid=1, job_id="anchor", cpu_request=10, ram_request=1 << 10,
+            pod_affinity=((IN_SET, "app", ("a",)),),
+        ))
+        for uid in range(2, 120):
+            st.task_submitted(TaskInfo(
+                uid=uid, job_id=f"j{uid % 7}", cpu_request=10,
+                ram_request=1 << 10, labels=_random_labels(rng),
+            ))
+        st.build_round_view()  # activates the incremental index
+        assert st._residency.active
+
+        live = list(range(2, 120))
+        for step in range(40):
+            op = int(rng.integers(5))
+            pick = [int(u) for u in rng.choice(
+                live, size=min(len(live), 8), replace=False
+            )]
+            if op == 0:  # place / migrate a batch (some to None)
+                st.apply_placements([
+                    (u, uuids[int(rng.integers(len(uuids)))]
+                     if rng.random() < 0.8 else None)
+                    for u in pick
+                ])
+            elif op == 1:  # complete
+                for u in pick[:3]:
+                    st.task_completed(u)
+                    live.remove(u)
+            elif op == 2:  # preempt (unplace)
+                st.apply_placements([(u, None) for u in pick[:4]])
+            elif op == 3:  # relabel in place (TaskUpdated)
+                for u in pick[:3]:
+                    t = st.tasks[u]
+                    st.task_updated(TaskInfo(
+                        uid=u, job_id=t.job_id,
+                        cpu_request=t.cpu_request,
+                        ram_request=t.ram_request,
+                        labels=_random_labels(rng),
+                    ))
+            else:  # remove + resubmit fresh
+                for u in pick[:2]:
+                    st.task_removed(u)
+                    st.task_submitted(TaskInfo(
+                        uid=u, job_id="fresh", cpu_request=10,
+                        ram_request=1 << 10,
+                        labels=_random_labels(rng),
+                    ))
+            _assert_index_matches_rebuild(st)
+
+        # Machine failure and removal evict residents from the counts.
+        st.node_failed(uuids[0])
+        _assert_index_matches_rebuild(st)
+        st.node_removed(uuids[1])
+        _assert_index_matches_rebuild(st)
+
+    def test_deactivates_when_last_pod_selector_task_leaves(self):
+        st = ClusterState(use_native=False)
+        st.node_added(MachineInfo(
+            uuid=generate_uuid("d0"), cpu_capacity=4000,
+            ram_capacity=1 << 24,
+        ))
+        st.task_submitted(TaskInfo(
+            uid=1, job_id="a", cpu_request=10, ram_request=1 << 10,
+            pod_affinity=((IN_SET, "app", ("a",)),),
+        ))
+        st.build_round_view()
+        assert st._residency.active
+        st.task_removed(1)
+        assert not st._residency.active
+        # Reactivation rebuilds from live task state.
+        st.task_submitted(TaskInfo(
+            uid=2, job_id="a", cpu_request=10, ram_request=1 << 10,
+            pod_anti_affinity=((IN_SET, "app", ("a",)),),
+        ))
+        st.build_round_view()
+        assert st._residency.active
+
+    def test_column_compaction_keeps_counts(self):
+        """Rolling label vocabularies (ver=v0, v1, ...) must not grow
+        the column space without bound, and compaction must preserve
+        the live counts."""
+        import poseidon_tpu.graph.residency as R
+
+        idx = ResidentLabelIndex()
+        idx.activate()
+        for i in range(3 * R._COMPACT_MIN_COLS):
+            idx.add("m0", {"ver": f"v{i}"})
+            idx.remove("m0", {"ver": f"v{i}"})
+        idx.add("m0", {"ver": "live"})
+        assert len(idx.kv_id) <= R._COMPACT_MIN_COLS
+        view = idx.view(["m0", "m1"])
+        assert int(view.total[0]) == 1 and int(view.total[1]) == 0
+        c = view.kv_id[("ver", "live")]
+        assert int(view.kv_counts[0, c]) == 1
+
+    def test_label_index_cache_keyed_on_node_generation(self):
+        st = ClusterState(use_native=False)
+        u = generate_uuid("lc0")
+        st.node_added(MachineInfo(
+            uuid=u, cpu_capacity=4000, ram_capacity=1 << 24,
+            labels={"zone": "z1"},
+        ))
+        st.task_submitted(TaskInfo(
+            uid=1, job_id="a", cpu_request=10, ram_request=1 << 10,
+        ))
+        v1 = st.build_round_view()
+        st.apply_placements([(1, None)])  # task churn, nodes unchanged
+        v2 = st.build_round_view()
+        assert v2.machines.label_index is v1.machines.label_index
+        st.node_updated(MachineInfo(
+            uuid=u, cpu_capacity=4000, ram_capacity=1 << 24,
+            labels={"zone": "z2"},
+        ))
+        v3 = st.build_round_view()
+        assert v3.machines.label_index is not v2.machines.label_index
+        mask = selector_admissibility(
+            [((IN_SET, "zone", ("z2",)),)], v3.machines.labels,
+            v3.machines.label_index,
+        )
+        assert mask.tolist() == [[True]]
+
+
+class TestEndToEndThroughPlanner:
+    def test_restart_from_checkpoint_keeps_affinity(self, tmp_path):
+        """The mask engine's state is derived: a checkpoint restore
+        rebuilds it through the mutators and affinity still resolves."""
+        from poseidon_tpu.graph.snapshot import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        st = ClusterState(use_native=False)
+        for i in range(3):
+            st.node_added(MachineInfo(
+                uuid=generate_uuid(f"ck{i}"), cpu_capacity=4000,
+                ram_capacity=1 << 24,
+            ))
+        planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+        st.task_submitted(TaskInfo(
+            uid=1, job_id="db", cpu_request=100, ram_request=1 << 18,
+            labels={"app": "db"},
+        ))
+        planner.schedule_round()
+        path = tmp_path / "mask.ckpt"
+        save_checkpoint(st, planner, path)
+        st2, planner2 = load_checkpoint(path, use_native=False)
+        st2.task_submitted(TaskInfo(
+            uid=2, job_id="web", cpu_request=100, ram_request=1 << 18,
+            pod_affinity=((IN_SET, "app", ("db",)),),
+        ))
+        planner2.schedule_round()
+        assert (st2.tasks[2].scheduled_to
+                == st2.tasks[1].scheduled_to is not None)

@@ -137,3 +137,91 @@ def test_chained_late_decline_discards_speculative_assignment(monkeypatch):
     placed_uids = [d.task_id for d in deltas
                    if d.type == d.type.__class__.PLACE]
     assert len(placed_uids) == len(set(placed_uids)) == 520
+
+
+def test_chained_scale_covers_band2_heavy_waves(monkeypatch):
+    """Regression (ADVICE r05): the shared scale must derive from the
+    LARGER band's row padding.  E2 >> E1 at an exact padding-bucket M
+    (320 = 256 * 1.25, so m_pad == M): with the old e1_pad-only
+    derivation, scale = 332 < E2 + M + 3 = 371 and band 2's exactness
+    certificate could never reach gap_bound == 0 — the chain paid its
+    dispatch and then silently declined every fresh wave."""
+    monkeypatch.setenv("POSEIDON_CHAINED", "1")
+    monkeypatch.setenv("POSEIDON_HOST_CERT", "0")
+    st = ClusterState()
+    for i in range(320):
+        st.node_added(MachineInfo(
+            uuid=generate_uuid(f"sc{i}"), cpu_capacity=64000,
+            ram_capacity=1 << 26, task_slots=48,
+        ))
+    # Band 1: two EC rows.  Band 2: 48 distinct rows (e2_pad = 64).
+    for e in range(2):
+        for i in range(3):
+            st.task_submitted(TaskInfo(
+                uid=task_uid(f"big{e}", i), job_id=f"big{e}",
+                cpu_request=6000 + 1000 * e, ram_request=1 << 22,
+            ))
+    for e in range(48):
+        for i in range(4):
+            st.task_submitted(TaskInfo(
+                uid=task_uid(f"small{e}", i), job_id=f"small{e}",
+                cpu_request=150 + 10 * e, ram_request=1 << 18,
+            ))
+    planner = RoundPlanner(st, CpuMemCostModel())
+    _, m = planner.schedule_round()
+    # The chained program owned the round: ONE dispatch, certified.
+    assert m.device_calls == 1
+    assert m.converged and m.gap_bound == 0.0
+    assert m.placed == 2 * 3 + 48 * 4
+    assert m.unscheduled == 0
+
+
+def test_chained_declines_on_band2_flow_mass_overflow():
+    """Regression (ADVICE r05): band-2 validation must use the REAL
+    (unclipped) slot capacities — an instance whose slot sum breaks
+    int32 flow arithmetic declines loudly BEFORE any dispatch instead
+    of validating a silently clipped bound and wasting the dispatch."""
+    import poseidon_tpu.ops.transport_chained as TC
+    from poseidon_tpu.costmodel.base import ECTable, MachineTable
+    from poseidon_tpu.costmodel.device_build import extract_band_operands
+    from poseidon_tpu.ops.transport import _Telemetry
+
+    M = 600
+    mt = MachineTable(
+        uuids=[f"fm{i}" for i in range(M)],
+        cpu_capacity=np.full(M, 64000, dtype=np.int64),
+        ram_capacity=np.full(M, 1 << 26, dtype=np.int64),
+        cpu_used=np.zeros(M, dtype=np.int64),
+        ram_used=np.zeros(M, dtype=np.int64),
+        cpu_util=np.zeros(M, dtype=np.float32),
+        mem_util=np.zeros(M, dtype=np.float32),
+        # 600 x 2^22 slots: sum ~2.5e9 >= 2^31.
+        slots_free=np.full(M, 1 << 22, dtype=np.int32),
+        labels=[{} for _ in range(M)],
+    )
+    ecs2 = ECTable(
+        ec_ids=np.array([1], dtype=np.uint64),
+        cpu_request=np.array([100], dtype=np.int64),
+        ram_request=np.array([1 << 18], dtype=np.int64),
+        supply=np.array([2], dtype=np.int32),
+        priority=np.zeros(1, dtype=np.int32),
+        task_type=np.zeros(1, dtype=np.int32),
+        max_wait_rounds=np.zeros(1, dtype=np.int32),
+        selectors=[()],
+    )
+    model = CpuMemCostModel()
+    ops2 = extract_band_operands(ecs2, mt, model)
+    calls0 = _Telemetry.device_calls
+    out = TC.solve_wave_chained(
+        np.ones((1, M), dtype=np.int32),
+        np.array([2], dtype=np.int32),
+        np.ones(M, dtype=np.int32),
+        np.array([100], dtype=np.int32),
+        None,
+        np.array([6000], dtype=np.int32),
+        np.array([1 << 12], dtype=np.int32),
+        ops2, np.asarray(ecs2.supply),
+        max_cost_hint=model.max_cost(),
+    )
+    assert out is None
+    assert _Telemetry.device_calls == calls0  # declined pre-dispatch
