@@ -20,6 +20,12 @@ bench:
 bench-small:
 	$(PY) bench.py --machines 500 --tasks 5000 --ecs 50 --rounds 3 --verbose
 
+# Tiny-scale (~200-machine) features-config run on CPU: a fast
+# regression gate for the selector/affinity/gang paths' latency AND
+# semantics (zero violations, whole gangs), without the full bench.
+bench-smoke:
+	$(PY) -m pytest tests/test_bench_smoke.py -q -m slow -p no:cacheprovider
+
 protos:
 	$(PY) -m poseidon_tpu.protos.gen
 

@@ -520,10 +520,16 @@ def run_features(machines: int, rounds: int) -> dict:
     from poseidon_tpu.utils import stagetimer
     from poseidon_tpu.utils.ids import generate_uuid, task_uid
 
-    # Per-stage sub-timings for the constraint rounds: the affinity and
-    # gang configs are host-masking-bound, not solver-bound, so the
-    # artifact carries where the round actually went (mask build vs
-    # cost build vs solve) next to the headline latency.
+    # Per-stage sub-timings for the constraint rounds.  PR 2 made the
+    # affinity config mask-cheap (mask build ~0.3 s of a 2.25 s round)
+    # and showed the gang config was SOLVE-side-bound (15.2 s of a
+    # 17.1 s round in band solves; mask build 0.001 s) — round 7 then
+    # profiled that solve time down to compile storms + uncertifiable
+    # warm starts and fixed both (pruned planes, greedy retry passes,
+    # repair-start host certificates).  The artifact carries where the
+    # round actually went (mask build vs cost build vs solve) next to
+    # the headline latency so the next shift in the bottleneck is
+    # visible, not inferred.
     os.environ["POSEIDON_STAGE_TIMERS"] = "1"
 
     def _stage_timings() -> dict:
@@ -690,6 +696,19 @@ def run_features(machines: int, rounds: int) -> dict:
         "placed_gangs": placed_gangs,
         "partial_gangs": partial_gangs,
         "oversized_gang_placed": big_placed,
+        # Solve-side telemetry: the gang round's latency lives in the
+        # solves (repair re-solves included — their work folds into
+        # solve_iters/bf_sweeps via the planner's hidden counters).
+        "solve_iters": mg.iterations,
+        "bf_sweeps": mg.bf_sweeps,
+        "device_calls": mg.device_calls,
+        "repair_firings": mg.repair_firings,
+        "pruned": {
+            "bands": mg.pruned_bands,
+            "shortlist_width": mg.pruned_width,
+            "price_out_rounds": mg.pruned_price_out_rounds,
+            "escalations": mg.pruned_escalations,
+        },
         **_stage_timings(),
     }
     out["ok"] = (

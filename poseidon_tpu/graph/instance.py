@@ -84,6 +84,17 @@ class RoundMetrics:
     # dominant per-iteration op-count term (tuning signal for
     # global_update_every / bf_max).
     bf_sweeps: int = 0
+    # Gang-atomicity repair firings (_forbid_partial_gangs) this round;
+    # the re-solves they trigger also fold into `iterations`/`bf_sweeps`
+    # via the hidden counters.
+    repair_firings: int = 0
+    # Pruned-plane solve path (ops/transport_pruned): bands solved on a
+    # column shortlist, the widest shortlist used, price-out re-solve
+    # rounds, and escalations back to the dense path.
+    pruned_bands: int = 0
+    pruned_width: int = 0
+    pruned_price_out_rounds: int = 0
+    pruned_escalations: int = 0
     # False when any band's solve exhausted its iteration budget even on a
     # cold retry (gap_bound is then inf and the committed placement is the
     # repaired feasible-but-suboptimal one).  Alarmed via log.error.
@@ -353,6 +364,16 @@ class RoundPlanner:
         self._last_generation = -1
         self._last_unscheduled = 1  # force a solve on the first round
         self.last_metrics = RoundMetrics()
+        # Per-round solve-telemetry accumulators (reset in _solve_banded;
+        # initialized here so direct _solve_band/_solve_plane callers —
+        # tests, future tools — never trip on a missing attribute).
+        self._hidden_iters = 0
+        self._hidden_bf = 0
+        self._repair_firings = 0
+        self._pruned_bands = 0
+        self._pruned_width = 0
+        self._pruned_rounds = 0
+        self._pruned_escalations = 0
 
     # ------------------------------------------------------------- warm frames
 
@@ -985,6 +1006,11 @@ class RoundPlanner:
         iters = 0
         self._hidden_iters = 0
         self._hidden_bf = 0
+        self._repair_firings = 0
+        self._pruned_bands = 0
+        self._pruned_width = 0
+        self._pruned_rounds = 0
+        self._pruned_escalations = 0
         remaining = sorted(set(bands.tolist()))
         if len(remaining) > 1:
             chained = self._try_chained_wave(
@@ -1037,6 +1063,11 @@ class RoundPlanner:
         metrics.gap_bound = gap
         metrics.iterations = iters + self._hidden_iters
         metrics.bf_sweeps += self._hidden_bf
+        metrics.repair_firings = self._repair_firings
+        metrics.pruned_bands = self._pruned_bands
+        metrics.pruned_width = self._pruned_width
+        metrics.pruned_price_out_rounds = self._pruned_rounds
+        metrics.pruned_escalations = self._pruned_escalations
         return flows_full
 
     def _try_chained_wave(self, ecs, mt, bands, remaining, committed_cpu,
@@ -1198,7 +1229,15 @@ class RoundPlanner:
     def _solve_band(self, band, ecs_b, cm, col_cap, machine_uuids):
         """One band's solve: warm-started (per-band frames are stable
         across rounds because the band of an EC is a function of its
-        size), drift-derived epsilon ladder, gang atomicity repair."""
+        size), drift-derived epsilon ladder, gang atomicity repair.
+
+        The solve itself runs through ``_solve_plane`` — either on the
+        full plane, or (when the shortlist gate fires: dense, wide,
+        row-heavy bands) on the pruned plane with a full-plane price-out
+        certificate (``_try_pruned_band``), with the dense path as the
+        universal escalation fallback.  Warm frames are always saved in
+        FULL-plane coordinates, so carried prices survive the pruned
+        path's column remap round to round."""
         eps_start = None
         prices = flows0 = unsched0 = None
         if self.incremental:
@@ -1224,7 +1263,176 @@ class RoundPlanner:
                 # potentials mass-saturates arcs the ladder then
                 # unwinds).  Cold is uniformly fast and certified.
                 prices = flows0 = unsched0 = None
+        warm_state = (prices, flows0, unsched0, eps_start)
 
+        out = self._try_pruned_band(ecs_b, cm, col_cap, warm_state)
+        if out is None:
+            out = self._solve_plane(
+                ecs_b, cm.costs, col_cap, cm.arc_capacity,
+                cm.unsched_cost, warm_state,
+            )
+        sol, effective_costs = out
+
+        if sol.gap_bound != float("inf"):
+            self._warm_bands[band] = _WarmState(
+                ec_ids=list(ecs_b.ec_ids.tolist()),
+                machine_uuids=list(machine_uuids),
+                prices=sol.prices,
+                flows=sol.flows,
+                unsched=sol.unsched,
+                # The saved frame must be the costs the final prices are
+                # optimal for (gang repair may have forbidden rows).
+                costs=effective_costs.astype(np.int64),
+                unsched_cost=cm.unsched_cost.astype(np.int64),
+            )
+        else:
+            # A budget-exhausted state has no usable dual structure:
+            # carrying it would poison the next round's warm attempt.
+            self._warm_bands.pop(band, None)
+        return sol
+
+    def _try_pruned_band(self, ecs_b, cm, col_cap, warm_state):
+        """Pruned-plane attempt (ops/transport_pruned): run the band's
+        pipeline — coarse start, warm dispatch — on the union of
+        per-row cheapest-column shortlists, certify the lifted solution
+        against the full plane (growing the shortlist by the price-out's
+        violating columns when the certificate fails), and only then
+        apply gang-atomicity repair: each firing forbids rows in the
+        BASE costs and re-solves through the same certified pruned loop,
+        so every forbid decision is made on a full-plane-certified
+        optimum — identical semantics to the dense repair (a gang
+        starved only by shortlist narrowness shows up as a price-out
+        violation, never as a forbidden gang).  Returns ``(sol,
+        effective_costs_full)``, or ``None`` when the gate declines or
+        any stage escalates — the caller then runs the dense path with
+        the SAME warm state, exactly as if the gate had declined."""
+        if (self.flow_solver != "auction" or self.solver_devices != 1
+                or os.environ.get("POSEIDON_PRUNED", "1") == "0"):
+            return None
+        from poseidon_tpu.ops import transport_pruned as tp
+        from poseidon_tpu.ops.transport import derive_scale, padded_shape
+
+        E, M = cm.costs.shape
+        scale_full = None
+        repair = (
+            self.gang_scheduling and ecs_b.is_gang is not None
+            and bool(ecs_b.is_gang.any())
+        )
+        eff_base = cm.costs
+        warm = warm_state
+        sol = None
+        for attempt in range(int(ecs_b.is_gang.sum()) + 1 if repair else 1):
+            prices, flows0, unsched0, eps_start = warm
+            must = flows0.sum(axis=0) > 0 if flows0 is not None else None
+            plan = tp.plan_shortlist(
+                eff_base, ecs_b.supply, col_cap, cm.arc_capacity,
+                must_include=must,
+            )
+            if plan is None:
+                # Gate declined (round 0: never pruned; later: forbidden
+                # rows thinned the plane) — the dense path owns the band.
+                if attempt > 0:
+                    self._pruned_escalations += 1
+                if sol is not None:
+                    # The accepted-then-abandoned attempt's work must
+                    # stay visible (the dense fallback re-solves).
+                    self._hidden_iters += sol.iterations
+                    self._hidden_bf += sol.bf_sweeps
+                return None
+            if scale_full is None:
+                # Reduced solves run at the FULL instance's scale so
+                # every epsilon, dual, and certificate stays in
+                # full-instance units (the selective wrapper's rule).
+                # Derived only once a plan actually fired: the O(E*M)
+                # finite-cost scan must not tax every declining band.
+                scale_full, _ = derive_scale(
+                    cm.costs, cm.unsched_cost, self.cost_model.max_cost(),
+                    *padded_shape(E, M),
+                )
+
+            def solve_on(sel, warm_r, _eff=eff_base, _w=warm):
+                costs_r = np.ascontiguousarray(_eff[:, sel])
+                arc_r = (np.ascontiguousarray(cm.arc_capacity[:, sel])
+                         if cm.arc_capacity is not None else None)
+                p, f, u, eps = _w
+                if warm_r is None and p is not None:
+                    # Round 0: the carried frame, column-sliced onto the
+                    # shortlist (must_include kept every column holding
+                    # warm flow, so nothing is widened away).
+                    warm_r = (
+                        np.concatenate([
+                            p[:E], p[E:E + M][sel], p[E + M:],
+                        ]),
+                        np.ascontiguousarray(f[:, sel]), u, eps,
+                    )
+                elif warm_r is None:
+                    warm_r = (None, None, None, None)
+                return self._solve_plane(
+                    ecs_b, costs_r, col_cap[sel], arc_r, cm.unsched_cost,
+                    warm_r, scale=scale_full, gang_repair=False,
+                )
+
+            prev = sol
+            sol, eff_full, stats = tp.solve_pruned(
+                eff_base, ecs_b.supply, col_cap, cm.unsched_cost,
+                arc_capacity=cm.arc_capacity, scale=scale_full, plan=plan,
+                solve_on=solve_on,
+            )
+            self._pruned_width = max(self._pruned_width, stats["width"])
+            self._pruned_rounds += stats["rounds"]
+            if sol is None:
+                # Escalated attempts' device work must stay visible —
+                # the failed attempt's AND any accepted-then-abandoned
+                # earlier attempt's (the dense fallback starts over).
+                self._hidden_iters += stats["iterations"]
+                self._hidden_bf += stats["bf_sweeps"]
+                if prev is not None:
+                    self._hidden_iters += prev.iterations
+                    self._hidden_bf += prev.bf_sweeps
+                self._pruned_escalations += 1
+                return None
+            if prev is not None:
+                # The replaced (pre-repair) solve's work, as in the
+                # dense repair loop.
+                self._hidden_iters += prev.iterations
+                self._hidden_bf += prev.bf_sweeps
+            if not repair:
+                break
+            placed = sol.flows.sum(axis=1)
+            partial = (
+                ecs_b.is_gang & (placed > 0) & (placed < ecs_b.supply)
+            )
+            if not partial.any():
+                break
+            self._repair_firings += 1
+            if eff_base is cm.costs:
+                eff_base = cm.costs.copy()
+            eff_base[partial] = INF_COST
+            # Warm re-solve from the certified state, eps=1 — the dense
+            # repair's exact policy (_forbid_partial_gangs).
+            warm = (sol.prices, sol.flows, sol.unsched, 1)
+        self._pruned_bands += 1
+        # eff_full from the last accepted solve is eff_base itself (the
+        # closure never forbids rows; repair forbids in the base).
+        return sol, eff_full
+
+    def _solve_plane(self, ecs_b, costs, col_cap, arc_capacity,
+                     unsched_cost, warm_state, scale=None,
+                     gang_repair=True):
+        """The per-plane solve pipeline: coarse warm start, warm/cold
+        dispatch with policy budgets, gang-atomicity repair.  Factored
+        out of ``_solve_band`` so the pruned path can run the IDENTICAL
+        pipeline on a column-reduced plane; ``scale`` then pins the full
+        instance's cost scale (``None`` — the dense path — derives it
+        per plane, exactly as before the split).  ``gang_repair=False``
+        skips the repair loop: the pruned path must not forbid a gang
+        off an UNCERTIFIED reduced optimum (a row starved only by
+        shortlist narrowness would be rejected where the dense path
+        places it whole), so its repair runs in ``_try_pruned_band``
+        on full-plane-certified solutions only.  Returns ``(sol,
+        effective_costs)``; ``effective_costs`` is what the final prices
+        are optimal for (gang repair may have forbidden rows)."""
+        prices, flows0, unsched0, eps_start = warm_state
         sol = None
         if (prices is None and self.flow_solver != "ssp"
                 and os.environ.get("POSEIDON_COARSE", "1") != "0"):
@@ -1254,20 +1462,24 @@ class RoundPlanner:
             # consume the bundle (a fused decline must not redo the
             # O(E*M) host work in the fallback).
             pre = coarse_precheck(
-                cm.costs, ecs_b.supply, col_cap, cm.arc_capacity,
-                cm.unsched_cost, hint,
+                costs, ecs_b.supply, col_cap, arc_capacity,
+                unsched_cost, hint, scale=scale,
             )
             if pre is not None:
                 if (self.solver_devices == 1
                         and not pre["certified"]
+                        and scale is None
                         and accel_policy("POSEIDON_COARSE_FUSED")):
+                    # Dense planes only (scale is None): the fused
+                    # pipeline derives its own scale internally, which
+                    # must not diverge from a pruned plane's pinned one.
                     from poseidon_tpu.ops.transport_coarse import (
                         solve_transport_coarse_fused,
                     )
 
                     sol = solve_transport_coarse_fused(
-                        cm.costs, ecs_b.supply, col_cap, cm.unsched_cost,
-                        arc_capacity=cm.arc_capacity, max_cost_hint=hint,
+                        costs, ecs_b.supply, col_cap, unsched_cost,
+                        arc_capacity=arc_capacity, max_cost_hint=hint,
                         max_iter_total=8192,
                         global_update_every=self.global_update_every,
                         pre=pre,
@@ -1287,14 +1499,14 @@ class RoundPlanner:
                         return s
 
                     cs = coarse_warm_start(
-                        cm.costs, ecs_b.supply, col_cap, cm.unsched_cost,
-                        cm.arc_capacity, counting_solve,
+                        costs, ecs_b.supply, col_cap, unsched_cost,
+                        arc_capacity, counting_solve,
                         max_cost_hint=hint, pre=pre,
                     )
                     if cs is not None:
                         prices, flows0, unsched0, eps_start = cs
 
-        def run(costs, eps, p=None, f=None, u=None):
+        def run(run_costs, eps, p=None, f=None, u=None):
             # Policy iteration budgets (the kernel default is a pure
             # backstop): a warm attempt that has not converged within a
             # few times a typical warm solve (~200-500 iterations) is
@@ -1309,53 +1521,48 @@ class RoundPlanner:
             # converged=False + log.error alarm, no warm frame saved.
             is_warm = p is not None or f is not None
             return self._dispatch_solve(
-                costs, ecs_b.supply, col_cap, cm.unsched_cost, p,
-                arc_capacity=cm.arc_capacity, init_flows=f,
+                run_costs, ecs_b.supply, col_cap, unsched_cost, p,
+                arc_capacity=arc_capacity, init_flows=f,
                 init_unsched=u, eps_start=eps,
                 max_iter_total=2048 if is_warm else 8192,
                 # The model's static bound pins the cost scale (a compile
                 # key) regardless of per-round cost drift.
                 max_cost_hint=self.cost_model.max_cost(),
+                scale=scale,
             )
 
         if sol is None:
-            sol = run(cm.costs, eps_start, prices, flows0, unsched0)
+            sol = run(costs, eps_start, prices, flows0, unsched0)
             if prices is not None and sol.gap_bound == float("inf"):
                 # Any warm start can mislead (drift heuristic missed
                 # deep churn, or a poisoned carried frame): retry cold.
-                sol = run(cm.costs, None)
+                # The failed attempt's work stays visible through the
+                # hidden counters (it used to vanish from the metrics).
+                self._hidden_iters += sol.iterations
+                self._hidden_bf += sol.bf_sweeps
+                sol = run(costs, None)
 
-        effective_costs = cm.costs
+        effective_costs = costs
         if (
-            self.gang_scheduling
+            gang_repair
+            and self.gang_scheduling
             and ecs_b.is_gang is not None
             and ecs_b.is_gang.any()
         ):
             for _ in range(int(ecs_b.is_gang.sum())):
+                prev = sol
                 sol, effective_costs, fired = self._forbid_partial_gangs(
-                    sol, effective_costs, cm.costs, ecs_b.is_gang,
+                    sol, effective_costs, costs, ecs_b.is_gang,
                     ecs_b.supply, run,
                 )
                 if not fired:
                     break
-
-        if sol.gap_bound != float("inf"):
-            self._warm_bands[band] = _WarmState(
-                ec_ids=list(ecs_b.ec_ids.tolist()),
-                machine_uuids=list(machine_uuids),
-                prices=sol.prices,
-                flows=sol.flows,
-                unsched=sol.unsched,
-                # The saved frame must be the costs the final prices are
-                # optimal for (gang repair may have forbidden rows).
-                costs=effective_costs.astype(np.int64),
-                unsched_cost=cm.unsched_cost.astype(np.int64),
-            )
-        else:
-            # A budget-exhausted state has no usable dual structure:
-            # carrying it would poison the next round's warm attempt.
-            self._warm_bands.pop(band, None)
-        return sol
+                self._repair_firings += 1
+                # The replaced solve's iterations/sweeps used to vanish
+                # (metrics only ever saw the final sol).
+                self._hidden_iters += prev.iterations
+                self._hidden_bf += prev.bf_sweeps
+        return sol, effective_costs
 
     @staticmethod
     def _forbid_partial_gangs(sol, effective_costs, base_costs, gangs,

@@ -981,8 +981,8 @@ def greedy_flows(costs, supply, capacity, arc_capacity=None) -> np.ndarray:
     scratch: measured 811 -> 283 iterations on a contended 100x1000
     wave (identical objective — the solver still proves optimality).
     O(E * (M + k log k)) host numpy with k ~ supply per row; leftovers
-    (capacity races between rows) simply start as unscheduled excess and
-    are re-routed by the solver.
+    (arc caps, or genuinely exhausted capacity) start as unscheduled
+    excess and are re-routed by the solver.
     """
     E, M = costs.shape
     F = np.zeros((E, M), dtype=np.int32)
@@ -992,26 +992,47 @@ def greedy_flows(costs, supply, capacity, arc_capacity=None) -> np.ndarray:
         if s <= 0:
             continue
         row = costs[e]
-        # Cheapest s+64 columns suffice unless arc caps/races starve the
-        # row (then the solver repairs); avoids a full M log M sort.
+        # Cheapest s+64 columns usually suffice; avoids a full M log M
+        # sort.  Under TIED costs, though, every row partitions to the
+        # SAME shortlist, early rows saturate it, and later rows would
+        # starve while the plane still holds plenty of capacity — on a
+        # uniform-cost gang band this left ~95% of rows unplaced, an
+        # uncertifiable start that cost a real coarse dispatch.  Retry
+        # passes re-partition over the still-open columns (saturated
+        # ones masked to INF); each pass either places a unit or proves
+        # the row done, so the loop is bounded and rows that never
+        # starve see the original single pass bit-for-bit.
         k = min(M, s + 64)
-        if k < M:
-            idx = np.argpartition(row, k - 1)[:k]
-            idx = idx[np.argsort(row[idx], kind="stable")]
-        else:
-            idx = np.argsort(row, kind="stable")
-        for m in idx:
-            if s <= 0:
+        masked = None
+        for _retry in range(64):  # cap bounds adversarial arc-cap cases
+            src = row if masked is None else masked
+            if k < M:
+                idx = np.argpartition(src, k - 1)[:k]
+                idx = idx[np.argsort(src[idx], kind="stable")]
+            else:
+                idx = np.argsort(src, kind="stable")
+            placed_any = False
+            for m in idx:
+                if s <= 0:
+                    break
+                if src[m] >= INF_COST:
+                    break  # sorted: everything after is inadmissible too
+                take = min(int(cap_left[m]), s)
+                if arc_capacity is not None:
+                    take = min(take, int(arc_capacity[e, m]) - int(F[e, m]))
+                if take > 0:
+                    F[e, m] += take
+                    cap_left[m] -= take
+                    s -= take
+                    placed_any = True
+            if s <= 0 or k >= M:
+                break  # done, or the full sorted scan already saw it all
+            if masked is not None and not placed_any:
+                break  # a pass over open-only columns stalled: arc-blocked
+            open_cols = cap_left > 0
+            if not open_cols.any():
                 break
-            if row[m] >= INF_COST:
-                break  # sorted: everything after is inadmissible too
-            take = min(int(cap_left[m]), s)
-            if arc_capacity is not None:
-                take = min(take, int(arc_capacity[e, m]))
-            if take > 0:
-                F[e, m] = take
-                cap_left[m] -= take
-                s -= take
+            masked = np.where(open_cols, row, INF_COST).astype(row.dtype)
     return F
 
 
@@ -1077,7 +1098,7 @@ def coarse_group_columns(costs, groups: int) -> np.ndarray:
 
 
 def coarse_precheck(costs, supply, capacity, arc_capacity, unsched_cost,
-                    max_cost_hint, groups=None):
+                    max_cost_hint, groups=None, scale=None):
     """Shared size gates + greedy certificate for the coarse paths.
 
     Returns ``None`` when the instance is too small/thin for any coarse
@@ -1086,6 +1107,11 @@ def coarse_precheck(costs, supply, capacity, arc_capacity, unsched_cost,
     already near-optimal — both coarse paths then decline in favor of
     one plain dispatch seeded with it).  Computed ONCE per band by the
     planner so a fused decline does not redo the O(E*M) host work.
+
+    ``scale`` pins the cost scale (the pruned-plane path solves reduced
+    instances at the FULL instance's scale, and every epsilon this
+    precheck certifies must be in those units); ``None`` derives it from
+    the given plane, as the dense path always has.
     """
     E, M = costs.shape
     if E == 0 or M < COARSE_MIN_MACHINES:
@@ -1094,9 +1120,11 @@ def coarse_precheck(costs, supply, capacity, arc_capacity, unsched_cost,
     K = coarse_group_count(m_pad, groups)
     if M < 4 * K or int(supply.sum()) < 4 * K:
         return None
-    scale, max_raw_q = derive_scale(
+    d_scale, max_raw_q = derive_scale(
         costs, unsched_cost, max_cost_hint, e_pad, m_pad
     )
+    if scale is None:
+        scale = d_scale
     gf, gleft, gprices, geps, certified = greedy_dual_precheck(
         costs, supply, capacity, arc_capacity, unsched_cost,
         max_cost_hint, e_pad, m_pad, scale,
@@ -1373,6 +1401,17 @@ def maybe_greedy_start(greedy_init, init_flows, init_prices, init_unsched,
             net = np.full(E, BIG, dtype=np.int64)
             np.minimum.at(net, ru, Cs_u - pm0[cu])
             pe0 = np.where(has_flow, -net, -scale * marginal)
+            # A partially-fed row (leftover > 0) is, at equilibrium,
+            # priced by the FALLBACK it actually pays (pe = pt - u*s;
+            # marginal is the unscheduled cost for these rows): letting
+            # the loaded-arc utility override it leaves the loaded
+            # fallback arc with a large positive reduced cost, so a
+            # capacity-starved row — the one case where greedy is
+            # provably optimal and every admissible arc is saturated —
+            # never certified (observed: the oversized-gang band paid a
+            # coarse dispatch for a start that was already exact).
+            pe0 = np.where(leftover > 0,
+                           np.minimum(pe0, -scale * marginal), pe0)
     else:
         C64 = costs.astype(np.int64)
         used = init_flows > 0
@@ -1398,6 +1437,10 @@ def maybe_greedy_start(greedy_init, init_flows, init_prices, init_unsched,
             # without flow keep their greedy/fallback marginal).
             net = np.where(used, Cs - pm0[None, :], BIG).min(axis=1)
             pe0 = np.where(has_flow, -net, -scale * marginal)
+            # Partially-fed rows price at the fallback they pay (see the
+            # sparse engine above for the full rationale).
+            pe0 = np.where(leftover > 0,
+                           np.minimum(pe0, -scale * marginal), pe0)
     pm0 = np.clip(pm0, -(PRICE_SPREAD_CAP - 1), PRICE_SPREAD_CAP - 1)
     pe0 = np.clip(pe0, -(PRICE_SPREAD_CAP - 1), PRICE_SPREAD_CAP - 1)
     # Sink potential: machines with spare sink capacity need
@@ -1636,6 +1679,63 @@ def _host_finalize(flows, unsched, prices, iters, *,
     )
 
 
+def _repair_start_candidate(init_flows, init_unsched, init_prices, *,
+                            costs, supply, capacity, unsched_cost, scale,
+                            arc_capacity=None):
+    """Host-certified answer for warm starts stranded on forbidden arcs.
+
+    The gang-repair re-solve (and selector churn) hands back a warm frame
+    whose flow sits on arcs the CURRENT costs forbid (freshly INF'd rows)
+    or whose arc bound tightened.  The device would clip that flow at
+    solve init and re-route the excess — but dispatching for it costs a
+    round trip (and, observed live at 10k, a poisoned warm state can burn
+    the entire warm iteration budget before the cold retry answers in
+    zero iterations).  Mirror the clip on host instead: drop the stranded
+    flow, refill the fallback, and re-price only what the clip touched —
+    rows that gained fallback load pin to the fallback equilibrium
+    (pe <= pt - u*s), columns whose flow vanished re-price by the same
+    conservative residual-arc lift the column-reduction path uses.  The
+    result is accepted ONLY when the full reduced-cost certificate then
+    passes exactly (gap_bound == 0), so any start whose freed capacity
+    genuinely attracts other rows still dispatches.  Returns the repaired
+    ``TransportSolution`` candidate, or ``None`` when the clipped start
+    cannot be made feasible without the solver.
+    """
+    E, M = costs.shape
+    fl = np.where(costs < INF_COST, init_flows, 0).astype(np.int32)
+    if arc_capacity is not None:
+        fl = np.minimum(fl, arc_capacity).astype(np.int32)
+    rowsum = fl.sum(axis=1, dtype=np.int64)
+    un64 = supply.astype(np.int64) - rowsum
+    if (un64 < 0).any():
+        return None  # over-supplied rows: the kernel's clip owns this
+    un = un64.astype(np.int32)
+    pe = init_prices[:E].astype(np.int64)
+    pm = init_prices[E:E + M].astype(np.int64)
+    pt = int(init_prices[E + M])
+    gained_fb = un64 > np.asarray(init_unsched).astype(np.int64)
+    if gained_fb.any():
+        pe = np.where(
+            gained_fb,
+            np.minimum(pe, pt - unsched_cost.astype(np.int64) * scale),
+            pe,
+        )
+    freed = (fl.sum(axis=0) == 0) & (np.asarray(init_flows).sum(axis=0) > 0)
+    if freed.any():
+        keep = np.nonzero(~freed)[0]
+        pm = _lift_excluded_prices(
+            pe, pm[keep], pt, keep, costs=costs, capacity=capacity,
+            scale=scale,
+        )
+    prices = np.concatenate([pe, pm, np.int64([pt])])
+    prices = np.clip(prices, _NEG // 2, _POS).astype(np.int32)
+    return _host_finalize(
+        fl, un, prices, 0, costs=costs, supply=supply, capacity=capacity,
+        unsched_cost=unsched_cost, scale=scale, clean=True,
+        arc_capacity=arc_capacity,
+    )
+
+
 def solve_transport(
     costs: np.ndarray,
     supply: np.ndarray,
@@ -1789,20 +1889,29 @@ def solve_transport(
             # repair re-solves with freshly INF'd rows; selector churn
             # can do the same) is invisible to the epsilon certificate
             # (inadmissible arcs are excluded from reduced-cost checks)
-            # but the device WOULD push it off — never skip then.
-            # Same blindness applies to a TIGHTENED finite arc bound:
-            # the device clamps the start to Uem and re-places the
-            # excess; the epsilon certificate's forward mask just
-            # skips saturated arcs.  Dispatch whenever the start
-            # exceeds either admissibility form.
+            # but the device WOULD push it off — the raw start must not
+            # be certified then.  Same blindness applies to a TIGHTENED
+            # finite arc bound: the device clamps the start to Uem and
+            # re-places the excess; the epsilon certificate's forward
+            # mask just skips saturated arcs.  Such starts get the
+            # kernel's own clip mirrored on host plus a targeted
+            # re-price (_repair_start_candidate) — still accepted only
+            # on an exact certificate, so a clip whose freed capacity
+            # genuinely attracts other rows dispatches as before.
             on_forbidden = bool(
                 init_flows[costs >= INF_COST].any()
             ) or (
                 arc_capacity is not None
                 and bool((init_flows > arc_capacity).any())
             )
-            cand = None
-            if not on_forbidden:
+            if on_forbidden:
+                cand = _repair_start_candidate(
+                    init_flows, init_unsched, init_prices,
+                    costs=costs, supply=supply, capacity=capacity,
+                    unsched_cost=unsched_cost, scale=scale,
+                    arc_capacity=arc_capacity,
+                )
+            else:
                 cand = _host_finalize(
                     init_flows, init_unsched, init_prices, 0,
                     costs=costs, supply=supply, capacity=capacity,

@@ -1,0 +1,420 @@
+"""Pruned-plane transportation solves: per-row column shortlists with a
+price-out optimality certificate.
+
+Why this exists: a gang-bound round carries hundreds of EC rows against a
+dense 10k-column plane, yet an optimal placement provably touches only a
+handful of columns per row (each row needs ``ceil(supply_e / col_cap)``
+columns).  FleetOpt's compress-and-route framing (PAPERS.md, arxiv
+2603.16514) applies directly: solve a compressed instance, then certify it
+against the full one.  The compression here is a *column shortlist* — the
+union of every row's k cheapest admissible columns, k sized so the union's
+capacity covers total supply with slack — and the certification is the
+classical price-out step of delayed column generation: with the reduced
+solve's prices (excluded columns priced by the same conservative lift the
+selective wrapper uses), any excluded arc with negative reduced cost at
+the certified epsilon invalidates the certificate; the violating columns
+join the shortlist and the instance re-solves warm.  Columns only ever
+grow, so the loop terminates; the final accept is the full-plane
+``_certified_eps``, so an accepted solution carries exactly the optimality
+guarantee a dense solve would.
+
+Division of labor vs ``solve_transport_selective``: the selective wrapper
+reduces ONE dispatch and falls back to the full width the moment its
+certificate fails — right for sparse steady-state churn.  This module
+reduces a whole *band pipeline* (warm frames, coarse start, gang-repair
+re-solves all run on the reduced plane, via the caller's ``solve_on``
+closure) and answers certificate failures by *growing the shortlist*
+instead of abandoning the reduction — right for dense, wide, row-heavy
+bands where every re-solve would otherwise drag the full plane through
+the epsilon ladder.  Escalation to the dense path remains the universal
+fallback (``solve_pruned`` returns ``sol=None``).
+
+Everything here is host-side numpy; the device work happens inside the
+caller's closure.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from poseidon_tpu.ops.transport import (
+    INF_COST,
+    TransportSolution,
+    _certified_eps,
+    _lift_excluded_prices,
+    bucket_size,
+    derive_scale,
+    normalize_prices,
+    padded_shape,
+)
+
+# Gate defaults (env-overridable per knob: tests and triage shrink them to
+# exercise the path at toy scale; production keeps the pruned path off the
+# small planes where the dense solve is already cheap).
+PRUNE_MIN_ROWS = 192       # POSEIDON_PRUNE_MIN_ROWS
+PRUNE_MIN_COLS = 4096      # POSEIDON_PRUNE_MIN_COLS
+# Dense-plane requirement: admissible cells * factor >= E * M.  Sparse
+# planes already have the gathered host paths + the selective wrapper;
+# the shortlist's argpartition passes would be pure overhead there.
+PRUNE_DENSE_FACTOR = 4
+# Union capacity must cover total supply with this slack factor — below
+# it, capacity contention forces flow beyond every row's cheap columns,
+# the certificate fails by construction (an excluded free column always
+# undercuts a loaded fallback arc), and the reduction is wasted work.
+PRUNE_SLACK = 2
+# The union (after shape bucketing) must stay under this fraction of the
+# full width or the reduction isn't buying anything.
+PRUNE_MAX_WIDTH_NUM = 1
+PRUNE_MAX_WIDTH_DEN = 2
+# Price-out loop bounds: violating columns added per offending row and
+# re-solve rounds before escalating to the dense path.
+PRICE_OUT_TOP_J = 8
+PRICE_OUT_MAX_ROUNDS = 3
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass
+class ShortlistPlan:
+    sel: np.ndarray   # sorted full-plane column ids in the union
+    k: int            # per-row shortlist width the union was built from
+
+
+def plan_shortlist(
+    costs: np.ndarray,
+    supply: np.ndarray,
+    capacity: np.ndarray,
+    arc_capacity: Optional[np.ndarray] = None,
+    *,
+    must_include: Optional[np.ndarray] = None,
+    min_rows: Optional[int] = None,
+    min_cols: Optional[int] = None,
+    dense_factor: Optional[int] = None,
+    slack: Optional[int] = None,
+    k0: Optional[int] = None,
+) -> Optional[ShortlistPlan]:
+    """Gate + shortlist build.  ``None`` means "solve dense".
+
+    The union is the per-row k cheapest *admissible* columns (k doubling
+    from ``k0`` until the union's column capacity covers ``slack`` times
+    total supply), plus ``must_include`` columns (warm-frame flow — a
+    carried assignment must never be widened away), padded with the
+    globally cheapest remaining columns up to a ``bucket_size`` width so
+    round-to-round union jitter cannot mint per-round XLA compile keys.
+    """
+    E, M = costs.shape
+    # Env tunables apply only when the caller left the knob unset —
+    # explicit arguments always win over ambient configuration.
+    if min_rows is None:
+        min_rows = _env_int("POSEIDON_PRUNE_MIN_ROWS", PRUNE_MIN_ROWS)
+    if min_cols is None:
+        min_cols = _env_int("POSEIDON_PRUNE_MIN_COLS", PRUNE_MIN_COLS)
+    dense_factor = (PRUNE_DENSE_FACTOR if dense_factor is None
+                    else dense_factor)
+    slack = PRUNE_SLACK if slack is None else slack
+    if E < min_rows or M < min_cols:
+        return None
+    adm = costs < INF_COST
+    if int(np.count_nonzero(adm)) * dense_factor < E * M:
+        return None
+    total_supply = int(supply.astype(np.int64).sum())
+    cap64 = capacity.astype(np.int64)
+    if total_supply <= 0 or slack * total_supply > int(cap64.sum()):
+        return None
+    width_cap = M * PRUNE_MAX_WIDTH_NUM // PRUNE_MAX_WIDTH_DEN
+
+    base_mask = np.zeros(M, dtype=bool)
+    if must_include is not None:
+        base_mask |= must_include
+    work = np.where(adm, costs, INF_COST)
+    rows_ix = np.arange(E)[:, None]
+
+    def union_for(k):
+        mask = base_mask.copy()
+        if k >= M:
+            mask |= adm.any(axis=0)
+            return mask
+        part = np.argpartition(work, k - 1, axis=1)[:, :k]
+        # Only admissible cells select their column: an inadmissible
+        # cell would add capacity no row in the shortlist can use.
+        sel_cells = adm[rows_ix, part]
+        mask[part[sel_cells]] = True
+        return mask
+
+    if k0 is None:
+        # Start from what a row actually needs — enough columns at the
+        # median column capacity to hold its own supply, plus margin.
+        # A fixed k0 makes the union E*k0 wide under diverse costs (rows
+        # share nothing), overshooting the width cap before capacity
+        # coverage ever gets a say.
+        pos_cap = cap64[cap64 > 0]
+        med_cap = int(np.median(pos_cap)) if pos_cap.size else 1
+        k0 = int(np.ceil(int(supply.max(initial=1)) / max(med_cap, 1))) + 2
+    k = max(4, min(k0, M))
+    need = slack * total_supply
+    k_lo = 0
+    mask = union_for(k)
+    while int(cap64[mask].sum()) < need:
+        if k >= M:
+            return None  # even the full admissible union can't cover
+        k_lo = k
+        k = min(2 * k, M)
+        mask = union_for(k)
+    # Binary-refine to the smallest covering k: the doubling can overshoot
+    # by almost 2x, and under tied costs the union tracks k directly, so
+    # an overshoot turns a viable reduction (e.g. 4000 of 10000 columns)
+    # into a width-cap decline.  Monotone in k; a dozen O(E*M) partition
+    # passes, trivial next to the solve work the reduction saves.
+    for _ in range(12):
+        if k - k_lo <= 1:
+            break
+        mid = (k + k_lo) // 2
+        cand = union_for(mid)
+        if int(cap64[cand].sum()) >= need:
+            k, mask = mid, cand
+        else:
+            k_lo = mid
+    width = int(mask.sum())
+    if width > width_cap:
+        return None
+    target = bucket_size(width, lo=32)
+    if target > width_cap:
+        # The quarter-octave bucket would round past the cap: the
+        # reduction is no longer buying a meaningful width.
+        return None
+    if target > width:
+        # Pad with the globally cheapest unselected columns (dead columns
+        # last) — extra columns only enlarge the union, never unsound.
+        col_min = np.where(adm.any(axis=0), work.min(axis=0), INF_COST)
+        order = np.argsort(col_min, kind="stable")
+        extra = order[~mask[order]][: target - width]
+        mask[extra] = True
+    return ShortlistPlan(sel=np.nonzero(mask)[0], k=k)
+
+
+def scatter_flows(sel: np.ndarray, flows_r: np.ndarray, M: int) -> np.ndarray:
+    """Reduced [E, W] flows -> full [E, M] (excluded columns zero)."""
+    E = flows_r.shape[0]
+    flows = np.zeros((E, M), dtype=np.int32)
+    flows[:, sel] = flows_r
+    return flows
+
+
+def lift_prices(sel: np.ndarray, prices_r: np.ndarray, *, costs: np.ndarray,
+                capacity: np.ndarray, scale: int) -> np.ndarray:
+    """Reduced prices -> full-plane prices, excluded columns priced by the
+    conservative residual-arc lift (transport._lift_excluded_prices)."""
+    E, M = costs.shape
+    pe = prices_r[:E]
+    pt = int(prices_r[E + sel.size])
+    pm = _lift_excluded_prices(
+        pe, prices_r[E:E + sel.size].astype(np.int64), pt, sel,
+        costs=costs, capacity=capacity, scale=scale,
+    )
+    return np.concatenate(
+        [pe.astype(np.int64), pm, np.int64([pt])]
+    ).astype(np.int64)
+
+
+def price_out_violations(
+    prices_full: np.ndarray,
+    *,
+    costs: np.ndarray,
+    supply: np.ndarray,
+    capacity: np.ndarray,
+    arc_capacity: Optional[np.ndarray],
+    scale: int,
+    mask: np.ndarray,
+    top_j: int,
+) -> Tuple[np.ndarray, int]:
+    """Columns outside ``mask`` holding an arc with reduced cost < -1.
+
+    Returns ``(cols_to_add, worst_violation)``: the union of each
+    offending row's ``top_j`` most negative excluded columns, and the
+    magnitude of the worst violation (the carried state is exactly
+    eps-optimal at that epsilon once the columns join the plane, so it
+    seeds the re-solve's ladder).  Empty when every excluded arc prices
+    out clean — the certificate failure is then internal to the union
+    and only the dense path can answer it.
+    """
+    E, M = costs.shape
+    cols_out = np.nonzero(~mask)[0]
+    if cols_out.size == 0:
+        return cols_out, 0
+    BIG = np.int64(1) << 60
+    pe = prices_full[:E].astype(np.int64)
+    pm_out = prices_full[E:E + M][cols_out].astype(np.int64)
+    sub = costs[:, cols_out]
+    adm = sub < INF_COST
+    uem = np.minimum(supply.astype(np.int64)[:, None],
+                     capacity.astype(np.int64)[cols_out][None, :])
+    if arc_capacity is not None:
+        uem = np.minimum(uem, arc_capacity[:, cols_out].astype(np.int64))
+    open_ = adm & (uem > 0)
+    rc = np.where(
+        open_, sub.astype(np.int64) * scale + pe[:, None] - pm_out[None, :],
+        BIG,
+    )
+    viol = rc < -1
+    if not viol.any():
+        return cols_out[:0], 0
+    worst = int(-(rc[viol].min()))
+    rows = np.nonzero(viol.any(axis=1))[0]
+    j = min(max(1, top_j), cols_out.size)
+    sub_rc = rc[rows]
+    if j < cols_out.size:
+        part = np.argpartition(sub_rc, j - 1, axis=1)[:, :j]
+    else:
+        part = np.broadcast_to(np.arange(cols_out.size),
+                               (rows.size, cols_out.size))
+    picked = viol[rows][np.arange(rows.size)[:, None], part]
+    taken = np.zeros(cols_out.size, dtype=bool)
+    taken[part[picked]] = True
+    return cols_out[taken], worst
+
+
+def solve_pruned(
+    costs: np.ndarray,
+    supply: np.ndarray,
+    capacity: np.ndarray,
+    unsched_cost: np.ndarray,
+    *,
+    arc_capacity: Optional[np.ndarray] = None,
+    scale: Optional[int] = None,
+    plan: Optional[ShortlistPlan] = None,
+    solve_on: Callable,
+    max_rounds: Optional[int] = None,
+    top_j: Optional[int] = None,
+    plan_kw: Optional[dict] = None,
+) -> Tuple[Optional[TransportSolution], Optional[np.ndarray], dict]:
+    """The pruned-plane driver: shortlist -> solve -> price-out loop.
+
+    ``solve_on(sel, warm)`` runs the caller's whole solve pipeline on the
+    plane restricted to columns ``sel`` and returns ``(sol_r,
+    effective_costs_r)`` — ``effective_costs_r`` is the reduced cost
+    matrix the returned prices are optimal for (gang repair may have
+    INF'd rows).  ``warm`` is ``None`` on the first round (the caller
+    applies its own warm-start policy) and ``(prices_r, flows_r,
+    unsched_r, eps_start)`` on price-out re-solves, already remapped to
+    the grown ``sel``.
+
+    Returns ``(sol, effective_costs_full, stats)``.  ``sol is None``
+    means escalate to the dense path (gate declined inside ``plan``,
+    reduced solve unconverged, price-out budget exhausted, or a
+    certificate failure no column addition can answer); stats always
+    reports what happened (``width``, ``rounds``, ``escalated``).
+    """
+    costs = np.asarray(costs, dtype=np.int32)
+    supply = np.asarray(supply, dtype=np.int32)
+    capacity = np.asarray(capacity, dtype=np.int32)
+    unsched_cost = np.asarray(unsched_cost, dtype=np.int32)
+    E, M = costs.shape
+    stats = {"width": 0, "rounds": 0, "escalated": False,
+             "declined": False, "iterations": 0, "bf_sweeps": 0}
+    if plan is None:
+        plan = plan_shortlist(costs, supply, capacity, arc_capacity,
+                              **(plan_kw or {}))
+    if plan is None:
+        stats["declined"] = True
+        return None, None, stats
+    if scale is None:
+        scale, _ = derive_scale(costs, unsched_cost, None,
+                                *padded_shape(E, M))
+    max_rounds = (PRICE_OUT_MAX_ROUNDS if max_rounds is None
+                  else max_rounds)
+    top_j = PRICE_OUT_TOP_J if top_j is None else top_j
+    # Looser than the plan gate's width cap on purpose: the initial cap
+    # decides whether the reduction is worth STARTING; once reduced work
+    # exists, abandoning it over a few price-out columns wastes more
+    # than the extra width costs.
+    grow_cap = M * 3 // 4
+
+    mask = np.zeros(M, dtype=bool)
+    mask[plan.sel] = True
+    stats["width"] = int(plan.sel.size)
+    warm = None
+    iters = 0
+    bf = 0
+    for rnd in range(max_rounds + 1):
+        sel = np.nonzero(mask)[0]
+        stats["width"] = int(sel.size)
+        sol_r, eff_r = solve_on(sel, warm)
+        iters += sol_r.iterations
+        bf += sol_r.bf_sweeps
+        # Mirrored into stats so an ESCALATED attempt's device work can
+        # still reach the caller's telemetry (the accepted path reports
+        # it through the returned solution instead).
+        stats["iterations"] = iters
+        stats["bf_sweeps"] = bf
+        # Exactly-certified reduced solves report gap_bound == 0 when
+        # scale > n_r and n_r/scale otherwise (_host_finalize); both are
+        # eps<=1 certificates.  Requiring literally 0.0 would make the
+        # pruned path escalate EVERY band at scales where the int32
+        # safety bound caps the cost scale below the node count (~40k
+        # padded machines) — a silent permanent 2x solve cost.
+        n_r = E + sel.size + 3
+        if not (sol_r.gap_bound <= n_r / float(scale)):
+            break  # unconverged / uncertified reduced solve: dense owns it
+        base_r = costs[:, sel]
+        forbidden = ((eff_r >= INF_COST) & (base_r < INF_COST)).any(axis=1)
+        if forbidden.any():
+            eff_full = costs.copy()
+            eff_full[forbidden] = INF_COST
+        else:
+            eff_full = costs
+        flows_full = scatter_flows(sel, sol_r.flows, M)
+        prices_full = lift_prices(sel, sol_r.prices, costs=eff_full,
+                                  capacity=capacity, scale=scale)
+        eps_full = _certified_eps(
+            flows_full, sol_r.unsched, prices_full, costs=eff_full,
+            supply=supply, capacity=capacity, unsched_cost=unsched_cost,
+            scale=scale, arc_capacity=arc_capacity,
+        )
+        if eps_full <= 1:
+            n = E + M + 3
+            sol = TransportSolution(
+                flows=flows_full,
+                unsched=sol_r.unsched.copy(),
+                prices=normalize_prices(prices_full),
+                objective=sol_r.objective,
+                gap_bound=0.0 if scale > n else n / float(scale),
+                iterations=iters,
+                bf_sweeps=bf,
+                phase_iters=sol_r.phase_iters,
+            )
+            return sol, eff_full, stats
+        if rnd == max_rounds:
+            break
+        add_cols, worst = price_out_violations(
+            prices_full, costs=eff_full, supply=supply, capacity=capacity,
+            arc_capacity=arc_capacity, scale=scale, mask=mask, top_j=top_j,
+        )
+        if add_cols.size == 0:
+            break  # violation inside the union: growing columns can't help
+        mask[add_cols] = True
+        if int(mask.sum()) > grow_cap:
+            break  # reduction no longer buying anything
+        stats["rounds"] += 1
+        sel_new = np.nonzero(mask)[0]
+        prices_r = np.concatenate([
+            prices_full[:E], prices_full[E:E + M][sel_new],
+            prices_full[E + M:],
+        ]).astype(np.int64)
+        prices_r = np.clip(
+            prices_r, np.iinfo(np.int32).min, np.iinfo(np.int32).max
+        ).astype(np.int32)
+        # The carried state is exactly eps-optimal at the worst included
+        # violation once the added columns join the plane.
+        warm = (prices_r, flows_full[:, sel_new], sol_r.unsched.copy(),
+                int(worst) + 1)
+    stats["escalated"] = True
+    return None, None, stats

@@ -1,0 +1,39 @@
+"""Tiny-scale features-config regression gate (``make bench-smoke``).
+
+Runs bench.run_features at ~200 machines on CPU — the same code path the
+cluster-scale bench drives, with the same semantic predicates (selector
+violations zero, affinity co-location total, gang atomicity) — so a
+feature-path latency or semantics breakage is caught without paying the
+full 10k-machine bench.  Slow-marked: excluded from the tier-1 gate, run
+via ``make bench-smoke`` or ``pytest -m slow``.
+"""
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def test_features_config_smoke():
+    import bench
+
+    out = bench.run_features(200, rounds=1)
+    assert out["ok"], out
+
+    sel = out["selectors"]
+    assert sel["violations"] == 0
+    assert sel["zoned_placed"] == sel["zoned_total"] > 0
+
+    aff = out["pod_affinity"]
+    assert aff["colocated"] == aff["targets"] > 0
+
+    g = out["gang"]
+    assert g["placed_gangs"] == g["gangs"] > 0
+    assert g["partial_gangs"] == 0
+    assert g["oversized_gang_placed"] == 0
+    # The solve-side telemetry contract: repair/pruned work must be
+    # visible in the artifact, not inferred from wall time.
+    for key in ("solve_iters", "bf_sweeps", "repair_firings", "pruned"):
+        assert key in g, f"gang sub-report missing {key}"
+    for key in ("bands", "shortlist_width", "price_out_rounds",
+                "escalations"):
+        assert key in g["pruned"], f"pruned stats missing {key}"

@@ -189,3 +189,103 @@ def test_gang_label_over_the_wire():
             # Only 2 of 3 members could fit: nothing places.
             assert deltas == []
             assert all(p.phase == "Pending" for p in kube.pods.values())
+
+
+def _zoned_gang_cluster(n_machines, zone_size, zone_cpu=16000):
+    """Machines with task_slots=1: a small selector-pinned "zone" (lower
+    CPU capacity, so open-capable tasks price away from it) plus an open
+    pool.  Gangs pinned to the zone contend for its few slots — the
+    deterministic multi-firing repair scenario."""
+    st = ClusterState()
+    for i in range(n_machines):
+        in_zone = i < zone_size
+        st.node_added(MachineInfo(
+            uuid=generate_uuid(f"zg{i}"),
+            cpu_capacity=zone_cpu if in_zone else 32000,
+            ram_capacity=128 << 20, task_slots=1,
+            labels={"pool": "zone" if in_zone else "open"},
+        ))
+    return st
+
+
+def _submit_zone_gang(st, name, n, cpu, zone):
+    from poseidon_tpu.costmodel.selectors import IN_SET
+
+    sel = ((IN_SET, "pool", ("zone",)),) if zone else ()
+    for i in range(n):
+        st.task_submitted(TaskInfo(
+            uid=task_uid(name, i), job_id=name, cpu_request=cpu,
+            ram_request=1 << 20, gang=True, selectors=sel,
+        ))
+
+
+def _run_multi_firing(st):
+    """Zone holds 25 slots; B(20) + C(15) + D(14) are pinned there with
+    costs B < C < D (cost grows with request).  The optimum places B
+    whole and C partially -> firing 1 forbids C; the re-solve places D
+    partially -> firing 2 forbids D; B survives whole.  A places in the
+    open pool throughout."""
+    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+    _, m = planner.schedule_round()
+
+    def placed(name, n):
+        return sum(
+            1 for i in range(n)
+            if st.tasks[task_uid(name, i)].scheduled_to is not None
+        )
+
+    assert placed("za", 30) == 30, "open-pool gang must place whole"
+    assert placed("zb", 20) == 20, "cheapest zone gang survives whole"
+    assert placed("zc", 15) == 0, "first-forbidden gang places nothing"
+    assert placed("zd", 14) == 0, "second-forbidden gang places nothing"
+    assert m.repair_firings == 2, m.repair_firings
+    return m
+
+
+def _multi_firing_cluster():
+    st = _zoned_gang_cluster(800, 25)
+    _submit_zone_gang(st, "za", 30, 1000, zone=False)
+    _submit_zone_gang(st, "zb", 20, 1200, zone=True)
+    _submit_zone_gang(st, "zc", 15, 1500, zone=True)
+    _submit_zone_gang(st, "zd", 14, 2000, zone=True)
+    return st
+
+
+def test_gang_repair_multi_firing_dense():
+    """>= 2 _forbid_partial_gangs firings before atomicity, dense path
+    (default shortlist gate declines at E=4)."""
+    m = _run_multi_firing(_multi_firing_cluster())
+    assert m.pruned_bands == 0
+
+
+def test_gang_repair_multi_firing_pruned(monkeypatch):
+    """The same scenario with the pruned-plane gate forced down to toy
+    scale: identical placement semantics, identical firing count, and
+    the band must actually have run on a shortlist."""
+    monkeypatch.setenv("POSEIDON_PRUNE_MIN_ROWS", "2")
+    monkeypatch.setenv("POSEIDON_PRUNE_MIN_COLS", "64")
+    m = _run_multi_firing(_multi_firing_cluster())
+    assert m.pruned_bands >= 1, "shortlist gate never fired"
+
+
+def test_oversized_gang_places_nothing_on_pruned_path(monkeypatch):
+    """A gang bigger than its admissible zone places nothing (atomicity)
+    when the band solves on the pruned plane."""
+    monkeypatch.setenv("POSEIDON_PRUNE_MIN_ROWS", "2")
+    monkeypatch.setenv("POSEIDON_PRUNE_MIN_COLS", "64")
+    st = _zoned_gang_cluster(256, 10)
+    _submit_zone_gang(st, "oa", 15, 1000, zone=False)
+    _submit_zone_gang(st, "oz", 16, 1200, zone=True)
+    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+    _, m = planner.schedule_round()
+    assert m.pruned_bands >= 1, "shortlist gate never fired"
+    placed_oz = sum(
+        1 for i in range(16)
+        if st.tasks[task_uid("oz", i)].scheduled_to is not None
+    )
+    placed_oa = sum(
+        1 for i in range(15)
+        if st.tasks[task_uid("oa", i)].scheduled_to is not None
+    )
+    assert placed_oz == 0 and placed_oa == 15
+    assert m.repair_firings >= 1

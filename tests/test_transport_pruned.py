@@ -1,0 +1,247 @@
+"""Pruned-plane solver path (ops/transport_pruned).
+
+Randomized parity of the shortlist + price-out driver against the dense
+solve and the exact host oracle, the engineered price-out escalation, the
+gate's decline conditions, and end-to-end planner parity with the pruned
+path forced on vs off.
+"""
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.ops import transport_pruned as tp
+from poseidon_tpu.ops.transport import (
+    INF_COST,
+    derive_scale,
+    padded_shape,
+    solve_transport,
+)
+from poseidon_tpu.solver.oracle import transport_objective
+
+
+def run_pruned(costs, supply, capacity, unsched_cost, arc_capacity=None,
+               plan_kw=None, **driver_kw):
+    """Drive solve_pruned with a plain solve_transport closure.
+
+    The planner drives the same loop with its full per-band pipeline
+    (coarse start, gang repair); the certificate contract is identical,
+    so solver-level parity transfers.
+    """
+    costs = np.asarray(costs, dtype=np.int32)
+    E, M = costs.shape
+    scale, _ = derive_scale(costs, unsched_cost, None, *padded_shape(E, M))
+
+    def solve_on(sel, warm):
+        p = f = u = eps = None
+        if warm is not None and warm[0] is not None:
+            p, f, u, eps = warm
+        sol = solve_transport(
+            costs[:, sel], supply, capacity[sel], unsched_cost, p,
+            arc_capacity=(
+                arc_capacity[:, sel] if arc_capacity is not None else None
+            ),
+            init_flows=f, init_unsched=u, eps_start=eps, scale=scale,
+        )
+        return sol, costs[:, sel]
+
+    kw = dict(min_rows=2, min_cols=16)
+    kw.update(plan_kw or {})
+    return tp.solve_pruned(
+        costs, supply, capacity, unsched_cost, arc_capacity=arc_capacity,
+        scale=scale, solve_on=solve_on, plan_kw=kw, **driver_kw,
+    )
+
+
+def assert_feasible(sol, costs, supply, capacity, arc_capacity=None):
+    assert (sol.flows >= 0).all()
+    assert (sol.flows.sum(axis=1) + sol.unsched == supply).all()
+    assert (sol.flows.sum(axis=0) <= capacity).all()
+    assert not sol.flows[costs >= INF_COST].any()
+    if arc_capacity is not None:
+        assert (sol.flows <= arc_capacity).all()
+
+
+def fuzz_instance(rng):
+    E = int(rng.integers(4, 11))
+    M = int(rng.integers(192, 320))
+    costs = rng.integers(1, 400, size=(E, M)).astype(np.int32)
+    density = float(rng.choice([1.0, 0.9, 0.7]))
+    if density < 1.0:
+        knock = rng.random((E, M)) > density
+        costs = np.where(knock, INF_COST, costs).astype(np.int32)
+    supply = rng.integers(1, 9, size=E).astype(np.int32)
+    capacity = rng.integers(1, 5, size=M).astype(np.int32)
+    # Generous slack so the shortlist gate fires and certificates
+    # typically accept (contention-driven escalations are exercised
+    # separately below).
+    while int(capacity.sum()) < 6 * int(supply.sum()):
+        capacity = (capacity * 2).astype(np.int32)
+    arc = None
+    if rng.random() < 0.5:
+        arc = rng.integers(1, 6, size=(E, M)).astype(np.int32)
+    unsched = np.full(E, 600, dtype=np.int32)
+    return costs, supply, capacity, unsched, arc
+
+
+def test_pruned_parity_fuzz_vs_dense_and_oracle():
+    accepted = 0
+    for seed in range(8):
+        rng = np.random.default_rng(seed)
+        costs, supply, capacity, unsched, arc = fuzz_instance(rng)
+        sol, eff, stats = run_pruned(
+            costs, supply, capacity, unsched, arc_capacity=arc,
+            plan_kw=dict(dense_factor=100),
+        )
+        dense = solve_transport(costs, supply, capacity, unsched,
+                                arc_capacity=arc)
+        oracle = transport_objective(costs, supply, capacity, unsched,
+                                     arc_capacity=arc)
+        assert dense.objective == oracle, f"seed {seed}: dense vs oracle"
+        if sol is None:
+            # The driver may legitimately decline (union too wide for
+            # the plane) or escalate; the planner then solves dense.
+            # Either way it must say so.
+            assert stats["escalated"] or stats["declined"], (
+                f"seed {seed}: None without a reason"
+            )
+            continue
+        accepted += 1
+        assert_feasible(sol, costs, supply, capacity, arc)
+        assert sol.objective == oracle, (
+            f"seed {seed}: pruned {sol.objective} != oracle {oracle} "
+            f"(stats {stats})"
+        )
+        assert sol.gap_bound == 0.0
+    # The accept path must be the norm on slack-rich fuzz, or the suite
+    # is only testing the escalation fallback.
+    assert accepted >= 5, f"only {accepted}/8 fuzz instances accepted"
+
+
+def _escalation_instance():
+    """Engineered to force a price-out round: the shortlist sizes itself
+    on COLUMN capacity, but every column it selects is arc-blocked for
+    every row, so the reduced optimum strands all supply on the fallback
+    while cheaper open columns sit just outside the union."""
+    E, M = 4, 128
+    costs = np.broadcast_to(
+        np.arange(M, dtype=np.int32), (E, M)
+    ).copy()
+    supply = np.full(E, 8, dtype=np.int32)
+    capacity = np.full(M, 2, dtype=np.int32)
+    unsched = np.full(E, 500, dtype=np.int32)
+    arc = np.full((E, M), 8, dtype=np.int32)
+    arc[:, :64] = 0  # the 64 cheapest columns: selected, unusable
+    return costs, supply, capacity, unsched, arc
+
+
+def test_price_out_adds_violating_columns_and_matches_oracle():
+    costs, supply, capacity, unsched, arc = _escalation_instance()
+    sol, eff, stats = run_pruned(costs, supply, capacity, unsched,
+                                 arc_capacity=arc)
+    assert sol is not None, stats
+    assert stats["rounds"] >= 1, f"no price-out round fired: {stats}"
+    oracle = transport_objective(costs, supply, capacity, unsched,
+                                 arc_capacity=arc)
+    assert sol.objective == oracle
+    assert_feasible(sol, costs, supply, capacity, arc)
+    # The optimum uses only columns the initial shortlist excluded.
+    assert not sol.flows[:, :64].any()
+    assert sol.unsched.sum() == 0
+
+
+def test_price_out_budget_exhaustion_escalates():
+    costs, supply, capacity, unsched, arc = _escalation_instance()
+    sol, eff, stats = run_pruned(costs, supply, capacity, unsched,
+                                 arc_capacity=arc, max_rounds=0)
+    assert sol is None and eff is None
+    assert stats["escalated"]
+
+
+def test_plan_gate_declines():
+    rng = np.random.default_rng(0)
+    costs = rng.integers(1, 100, size=(8, 256)).astype(np.int32)
+    supply = np.full(8, 4, dtype=np.int32)
+    capacity = np.full(256, 2, dtype=np.int32)
+    # Default thresholds: plane far too small.
+    assert tp.plan_shortlist(costs, supply, capacity) is None
+    # Capacity slack gate: demand beyond capacity / slack.
+    big_supply = np.full(8, 256, dtype=np.int32)
+    assert tp.plan_shortlist(costs, big_supply, capacity,
+                             min_rows=2, min_cols=16) is None
+    # Sparse plane: the density gate declines.
+    sparse = np.full((8, 256), INF_COST, dtype=np.int32)
+    sparse[:, :4] = 1
+    assert tp.plan_shortlist(sparse, supply, capacity,
+                             min_rows=2, min_cols=16) is None
+    # A qualifying plane fires and honors must_include.
+    must = np.zeros(256, dtype=bool)
+    must[255] = True
+    plan = tp.plan_shortlist(costs, supply, capacity, must_include=must,
+                             min_rows=2, min_cols=16)
+    assert plan is not None and 255 in plan.sel
+    assert plan.sel.size <= 128
+
+
+def test_planner_parity_pruned_vs_dense(monkeypatch):
+    """End-to-end: the same gang-mix cluster scheduled with the pruned
+    path forced on (tiny gate) vs off must produce identical objectives,
+    placement counts, and per-gang outcomes."""
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+    from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+    from poseidon_tpu.utils.ids import generate_uuid, task_uid
+
+    def build():
+        st = ClusterState()
+        for i in range(128):
+            st.node_added(MachineInfo(
+                uuid=generate_uuid(f"pp{i}"), cpu_capacity=32000,
+                ram_capacity=128 << 20, task_slots=4,
+            ))
+        for g in range(6):
+            for i in range(8):
+                st.task_submitted(TaskInfo(
+                    uid=task_uid(f"ppg{g}", i), job_id=f"ppg-{g}",
+                    cpu_request=1000 + 100 * g, ram_request=1 << 20,
+                    gang=True,
+                ))
+        for i in range(20):
+            st.task_submitted(TaskInfo(
+                uid=task_uid("pps", i), job_id=f"pps-{i % 4}",
+                cpu_request=1200, ram_request=1 << 20,
+            ))
+        return st
+
+    def run(pruned: bool):
+        if pruned:
+            monkeypatch.setenv("POSEIDON_PRUNED", "1")
+            monkeypatch.setenv("POSEIDON_PRUNE_MIN_ROWS", "2")
+            monkeypatch.setenv("POSEIDON_PRUNE_MIN_COLS", "32")
+        else:
+            monkeypatch.setenv("POSEIDON_PRUNED", "0")
+        st = build()
+        planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+        _, m = planner.schedule_round()
+        placements = {
+            uid: t.scheduled_to for uid, t in sorted(st.tasks.items())
+        }
+        return m, placements
+
+    m_dense, p_dense = run(False)
+    m_pruned, p_pruned = run(True)
+    assert m_pruned.pruned_bands >= 1, "pruned path never fired"
+    assert m_dense.pruned_bands == 0
+    assert m_pruned.objective == m_dense.objective
+    assert m_pruned.placed == m_dense.placed
+    assert m_pruned.unscheduled == m_dense.unscheduled
+    # Per-gang outcome parity: the same gangs run whole / wait whole.
+    for g in range(6):
+        from poseidon_tpu.utils.ids import task_uid as tu
+        placed_d = sum(
+            1 for i in range(8) if p_dense[tu(f"ppg{g}", i)] is not None
+        )
+        placed_p = sum(
+            1 for i in range(8) if p_pruned[tu(f"ppg{g}", i)] is not None
+        )
+        assert placed_d == placed_p, f"gang {g}: {placed_d} vs {placed_p}"
+        assert placed_p in (0, 8)
