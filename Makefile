@@ -4,7 +4,8 @@
 
 PY ?= python
 
-.PHONY: all test test-fast bench protos native verify lint demo clean
+.PHONY: all test test-fast bench protos native verify lint lint-fast \
+  demo demo-stop clean
 
 all: protos native lint test
 
@@ -44,17 +45,41 @@ lint:
 	else \
 	  echo "lint: ruff not installed; skipping (configs in pyproject.toml)"; \
 	fi
-	$(PY) -m poseidon_tpu.protos.gen
-	git diff --exit-code --stat -- 'poseidon_tpu/protos/*_pb2.py'
+	@if command -v protoc >/dev/null 2>&1; then \
+	  $(PY) -m poseidon_tpu.protos.gen && \
+	  git diff --exit-code --stat -- 'poseidon_tpu/protos/*_pb2.py'; \
+	else \
+	  echo "lint: protoc not installed; skipping proto drift gate" \
+	    "(gen.py did not regenerate, so a diff would not prove drift)"; \
+	fi
+
+# Pre-commit speed path: posecheck over git-changed files only.
+lint-fast:
+	$(PY) -m poseidon_tpu.check --changed poseidon_tpu/
 
 # Entry-point smoke: compile check + multichip dryrun + demo loop.
 verify: lint
 	$(PY) __graft_entry__.py
 
+# Backgrounded demo loop with its PID on record (out/demo.pid), so the
+# process no longer leaks: `make demo-stop` (or `make clean`) kills it.
 demo:
-	$(PY) -m poseidon_tpu.glue.main --demo --scheduling-interval=2 \
-	  --firmament-address=127.0.0.1:19090 &
+	@mkdir -p out
+	@$(PY) -m poseidon_tpu.glue.main --demo --scheduling-interval=2 \
+	  --firmament-address=127.0.0.1:19090 & \
+	  echo $$! > out/demo.pid; \
+	  echo "demo running (pid $$(cat out/demo.pid)); make demo-stop ends it"
 
-clean:
+demo-stop:
+	@if [ -f out/demo.pid ]; then \
+	  kill "$$(cat out/demo.pid)" 2>/dev/null \
+	    && echo "demo stopped (pid $$(cat out/demo.pid))" \
+	    || echo "demo pid $$(cat out/demo.pid) was not running"; \
+	  rm -f out/demo.pid; \
+	else \
+	  echo "no demo running (out/demo.pid absent)"; \
+	fi
+
+clean: demo-stop
 	rm -f poseidon_tpu/native/_graphcore.so
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -513,6 +513,7 @@ def run_features(machines: int, rounds: int) -> dict:
     """
     import jax
 
+    from poseidon_tpu.check.ledger import CompileLedger
     from poseidon_tpu.costmodel import get_cost_model
     from poseidon_tpu.costmodel.selectors import IN_SET
     from poseidon_tpu.graph.instance import RoundPlanner
@@ -568,11 +569,23 @@ def run_features(machines: int, rounds: int) -> dict:
         ))
     planner = RoundPlanner(state, get_cost_model("cpu_mem"))
     lat = []
+    fresh_per_round = []
     m = None
     for r in range(rounds):
         t0 = time.perf_counter()
-        _, m = planner.schedule_round()
+        if r == 0:
+            # Cold round: compiles are expected and paid here.
+            _, m = planner.schedule_round()
+        else:
+            # Warm churn rounds ride the compile ledger at budget 0:
+            # PR 3's hard-won invariant ("zero fresh compiles in a warm
+            # round") enforced in-band — a retrace regression fails the
+            # bench with the compiled program names, instead of hiding
+            # in round_p50_s the way the 15.2 s gang round did.
+            with CompileLedger(budget=0, label=f"warm selector round {r}"):
+                _, m = planner.schedule_round()
         lat.append(time.perf_counter() - t0)
+        fresh_per_round.append(m.fresh_compiles)
         submit_population(state, tasks // 100, 16, seed=r + 1)  # churn
     violations = zoned_placed = 0
     for uid, is_zoned in zoned.items():
@@ -595,6 +608,11 @@ def run_features(machines: int, rounds: int) -> dict:
         # place).
         "zoned_placed": zoned_placed,
         "zoned_total": n_zoned,
+        # Fresh XLA compiles per round (check/ledger.py): round 0 pays
+        # the cold compiles; every later (warm churn) round must report
+        # 0 — PR 3's invariant, now a visible artifact column.
+        "fresh_compiles": fresh_per_round,
+        "warm_fresh_compiles": sum(fresh_per_round[1:]),
     }
     # Partial line per completed stage (the parent salvages these on a
     # timeout, same contract as the rung/trace children).
@@ -639,10 +657,12 @@ def run_features(machines: int, rounds: int) -> dict:
         and state.tasks[task_uid("aff-web", i)].scheduled_to
         == state.tasks[task_uid("aff-db", i)].scheduled_to
     )
+    ma = planner.last_metrics
     out["pod_affinity"] = {
         "round_s": round(aff_s, 4),
         "targets": n_targets,
         "colocated": colocated,
+        "fresh_compiles": ma.fresh_compiles,
         **_stage_timings(),
     }
     print(json.dumps(out), flush=True)
@@ -674,7 +694,13 @@ def run_features(machines: int, rounds: int) -> dict:
     planner = RoundPlanner(state, get_cost_model("cpu_mem"))
     stagetimer.reset()
     t0 = time.perf_counter()
-    _, mg = planner.schedule_round()
+    # The gang round's compile keys are all warm by now (configs 2-3
+    # solved the same padded buckets this process) and its solves are
+    # host-certified at every measured scale (PR 3: zero dispatches at
+    # 10k) — so a fresh compile here IS the silent-retrace bug class,
+    # asserted at budget 0 exactly like the warm rounds.
+    with CompileLedger(budget=0, label="gang round"):
+        _, mg = planner.schedule_round()
     gang_s = time.perf_counter() - t0
     partial_gangs = placed_gangs = 0
     for g in range(n_gangs):
@@ -702,6 +728,7 @@ def run_features(machines: int, rounds: int) -> dict:
         "solve_iters": mg.iterations,
         "bf_sweeps": mg.bf_sweeps,
         "device_calls": mg.device_calls,
+        "fresh_compiles": mg.fresh_compiles,
         "repair_firings": mg.repair_firings,
         "pruned": {
             "bands": mg.pruned_bands,

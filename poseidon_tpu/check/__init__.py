@@ -1,17 +1,31 @@
 """posecheck: codebase-aware static analysis for poseidon_tpu.
 
-Three rule families, each scoped to the subsystem whose failure mode it
-guards (see docs/CHECKS.md):
+Five rules, each scoped to the subsystem whose failure mode it guards
+(see docs/CHECKS.md):
 
 - ``jit-purity``   — host-sync escapes inside jitted solver kernels
                      (``ops/``, ``solver/``);
 - ``lock-discipline`` — unlocked writes to lock-guarded state in the
                      threaded glue layer (``glue/``);
 - ``determinism``  — wall clock / unseeded RNG / unordered-set iteration
-                     in the replay and planning path (``replay/``,
-                     ``graph/``).
+                     / import-time env reads in the replay, planning,
+                     and kernel paths (``replay/``, ``graph/``,
+                     ``ops/``);
+- ``retrace-guard`` — recompile hazards at jit boundaries: per-call jit
+                     construction, dropped ``static_argnames``,
+                     unpadded shapes, weak-float promotion (``ops/``,
+                     ``graph/``);
+- ``dispatch-budget`` — every jitted kernel in ``ops/`` must be
+                     reachable from the precompile path (cross-file
+                     closure; judged in ``Rule.finalize``).
 
-CLI: ``python -m poseidon_tpu.check poseidon_tpu/`` (exit 1 on findings).
+The runtime complement is ``poseidon_tpu.check.ledger``: a
+``jax.monitoring``-fed ``CompileLedger`` asserting exact fresh-compile
+budgets around warm rounds (imported separately — it pulls in jax,
+which the static CLI deliberately does not).
+
+CLI: ``python -m poseidon_tpu.check poseidon_tpu/`` (exit 1 on findings;
+``--format=json`` for machines, ``--changed`` for pre-commit speed).
 Suppress a finding with a trailing ``# posecheck: ignore[rule-id]``.
 """
 

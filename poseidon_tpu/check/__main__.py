@@ -2,18 +2,28 @@
 
 Exit codes: 0 clean, 1 findings, 2 bad invocation.  Findings print as
 ``file:line rule-id message`` (the Makefile's ``lint`` target and editors
-both parse that shape).
+both parse that shape) or, under ``--format=json``, as one JSON object
+per line (``{"path", "line", "rule", "message"}``) for machine
+consumers (pre-commit hooks, CI annotators).
+
+``--changed`` scans only files touched relative to git HEAD (staged,
+unstaged, and untracked), intersected with the given paths — the fast
+pre-commit mode (``make lint-fast``).  Scope filters still apply, so a
+touched glue file gets the glue rules, not everything.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import subprocess
 import sys
 from pathlib import Path
 from typing import List, Optional
 
 from poseidon_tpu.check.core import (
     all_rules,
+    iter_py_files,
     load_baseline,
     run,
     rules_by_name,
@@ -23,10 +33,48 @@ from poseidon_tpu.check.core import (
 _DEFAULT_BASELINE = Path(__file__).parent / "baseline.txt"
 
 
+def changed_files(paths: List[str]) -> Optional[List[str]]:
+    """Python files changed vs HEAD (staged + unstaged + untracked),
+    restricted to ``paths``.  None when git itself fails (not a repo,
+    no git) — the caller reports a usage error rather than silently
+    scanning nothing.
+
+    git prints toplevel-relative names (and ``ls-files --others`` would
+    be cwd-scoped), so both commands run from the toplevel and the
+    comparison happens on RESOLVED absolute paths — a run from a
+    subdirectory must not silently drop tracked changes elsewhere in
+    the checkout.
+    """
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            capture_output=True, text=True, check=True, cwd=top,
+        ).stdout.splitlines()
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            capture_output=True, text=True, check=True, cwd=top,
+        ).stdout.splitlines()
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    scoped = {f.resolve(): f.as_posix() for f in iter_py_files(paths)}
+    out = []
+    for name in dict.fromkeys([*diff, *untracked]):  # ordered de-dup
+        resolved = Path(top, name).resolve()
+        if name.endswith(".py") and resolved in scoped \
+                and resolved.exists():
+            out.append(scoped[resolved])
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m poseidon_tpu.check",
-        description="posecheck: jit-purity / lock-discipline / determinism",
+        description="posecheck: jit-purity / lock-discipline / determinism"
+                    " / retrace-guard / dispatch-budget",
     )
     parser.add_argument(
         "paths", nargs="*", default=["poseidon_tpu/"],
@@ -37,6 +85,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="run only this rule, on every given path regardless of its "
              "default scope (repeatable); known: "
              + ", ".join(r.name for r in all_rules()),
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="finding output shape: `file:line rule message` lines "
+             "(text, default) or one JSON object per line (json)",
+    )
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="scan only files changed vs git HEAD (staged, unstaged, "
+             "untracked) within the given paths — fast pre-commit mode",
     )
     parser.add_argument(
         "--baseline", type=Path, default=_DEFAULT_BASELINE,
@@ -64,11 +122,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"no such path(s): {', '.join(missing)}", file=sys.stderr)
         return 2
 
+    paths = args.paths
+    if args.changed:
+        paths = changed_files(args.paths)
+        if paths is None:
+            print("--changed requires a git checkout", file=sys.stderr)
+            return 2
+        if not paths:
+            print("posecheck: no changed files in scope", file=sys.stderr)
+            return 0
+
     baseline = None if (args.no_baseline or args.write_baseline) \
         else args.baseline
-    findings = run(
-        args.paths, rules=rules, baseline=baseline, root=Path.cwd()
-    )
+    findings = run(paths, rules=rules, baseline=baseline, root=Path.cwd())
 
     if args.write_baseline:
         write_baseline(args.baseline, findings)
@@ -79,7 +145,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     for f in findings:
-        print(f.render())
+        if args.format == "json":
+            print(json.dumps(
+                {"path": f.path, "line": f.line, "rule": f.rule,
+                 "message": f.message},
+                sort_keys=True,
+            ))
+        else:
+            print(f.render())
     if findings:
         n_base = len(load_baseline(args.baseline)) if baseline else 0
         suffix = f" ({n_base} baselined)" if n_base else ""

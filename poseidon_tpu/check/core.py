@@ -93,6 +93,22 @@ class Rule:
     def check(self, tree: ast.AST, source: str, path: str) -> List[Finding]:
         raise NotImplementedError
 
+    def begin(self, paths: Sequence[str]) -> None:
+        """Called by ``run()`` with the raw scan paths before any file's
+        ``check()``.  Per-file rules ignore it; cross-file rules use it
+        to judge scan completeness (dispatch-budget only trusts its
+        reachability graph when whole directories were walked — a
+        file-list scan like ``--changed`` sees a partial graph)."""
+
+    def finalize(self) -> List[Finding]:
+        """Project-scoped findings, emitted once after every file's
+        ``check()`` ran.  Per-file rules return nothing; cross-file rules
+        (dispatch-budget's precompile-reachability closure) accumulate
+        facts in ``check()`` and judge here.  Implementations handle
+        their own suppressions (``check_file``'s line-scoped filter only
+        sees per-file findings) and must reset their accumulated state."""
+        return []
+
     def applies_to(self, path: str) -> bool:
         if not self.scopes:
             return True
@@ -102,10 +118,18 @@ class Rule:
 def all_rules() -> List[Rule]:
     # Local imports: the rule modules import this one for Rule/Finding.
     from poseidon_tpu.check.determinism import DeterminismRule
+    from poseidon_tpu.check.dispatch_budget import DispatchBudgetRule
     from poseidon_tpu.check.jit_purity import JitPurityRule
     from poseidon_tpu.check.lock_discipline import LockDisciplineRule
+    from poseidon_tpu.check.retrace_guard import RetraceGuardRule
 
-    return [JitPurityRule(), LockDisciplineRule(), DeterminismRule()]
+    return [
+        JitPurityRule(),
+        LockDisciplineRule(),
+        DeterminismRule(),
+        RetraceGuardRule(),
+        DispatchBudgetRule(),
+    ]
 
 
 def rules_by_name(names: Iterable[str]) -> List[Rule]:
@@ -250,10 +274,15 @@ def run(
     active = list(rules) if rules is not None else all_rules()
     baseline_keys = load_baseline(baseline) if baseline else set()
     findings: List[Finding] = []
+    for rule in active:
+        rule.begin(paths)
     for f in iter_py_files(paths):
         findings.extend(check_file(f, active, forced=forced, root=root))
+    for rule in active:
+        findings.extend(rule.finalize())
     if baseline_keys:
         findings = [
             f for f in findings if f.baseline_key() not in baseline_keys
         ]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings

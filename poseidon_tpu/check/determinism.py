@@ -17,6 +17,15 @@ warm-start reuse across rounds).  Three leak classes:
   sensitive consumer — event lists, cost-matrix row order, serialized
   output — silently diverges between runs.  ``sorted(set(...))`` is the
   fix and never flags.
+- import-time environment reads: ``os.environ``/``os.getenv`` at module
+  (or class-body) level pins the value at whatever the environment held
+  when the module was FIRST imported — tests and bench runs that set
+  the variable later silently no-op, and two processes with different
+  import orders can disagree (the ``POSEIDON_ITER_UNROLL`` pattern this
+  check exists to keep out: the value was baked into traced programs at
+  import).  Read at call time, or through an accessor.  This sub-check
+  also covers ``poseidon_tpu/ops/`` — env-tuned kernels are where the
+  pattern keeps trying to return.
 """
 
 from __future__ import annotations
@@ -134,7 +143,9 @@ def _collect_set_vars(fn: ast.AST) -> Set[str]:
 
 class DeterminismRule(Rule):
     name = "determinism"
-    scopes = ("poseidon_tpu/replay/", "poseidon_tpu/graph/")
+    scopes = (
+        "poseidon_tpu/replay/", "poseidon_tpu/graph/", "poseidon_tpu/ops/",
+    )
 
     def check(self, tree: ast.AST, source: str, path: str) -> List[Finding]:
         time_aliases = import_aliases(tree, "time")
@@ -175,7 +186,72 @@ class DeterminismRule(Rule):
         for scope in scopes:
             set_vars = _collect_set_vars(scope)
             self._check_set_iteration(scope, set_vars, set_fields, flag)
+
+        self._check_import_time_env(tree, flag)
         return findings
+
+    # -- import-time environment reads -------------------------------------
+
+    def _check_import_time_env(self, tree: ast.AST, flag) -> None:
+        os_aliases = import_aliases(tree, "os")
+        env_fns = {
+            local
+            for local, orig in from_imports(tree, "os").items()
+            if orig in ("getenv", "environ")
+        }
+
+        def is_env_read(node: ast.AST) -> bool:
+            if isinstance(node, ast.Call):
+                fname = dotted_name(node.func)
+                if fname is None:
+                    return False
+                head, _, rest = fname.partition(".")
+                if head in os_aliases and rest in (
+                    "getenv", "environ.get",
+                ):
+                    return True
+                if head in env_fns and rest in ("", "get"):
+                    return True
+            if isinstance(node, ast.Subscript):
+                vname = dotted_name(node.value)
+                if vname is None:
+                    return False
+                head, _, rest = vname.partition(".")
+                if head in os_aliases and rest == "environ":
+                    return True
+                if head in env_fns and not rest:
+                    return True
+            return False
+
+        def walk_import_time(node: ast.AST):
+            # Module and class bodies execute at import; function BODIES
+            # do not — their env reads are call-time.  But a def's
+            # decorators and argument DEFAULTS evaluate when the def
+            # statement runs (import time for module/class-level defs),
+            # so those subtrees stay in the walk.
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                ):
+                    args = child.args
+                    for sub in (
+                        *getattr(child, "decorator_list", ()),
+                        *args.defaults,
+                        *(d for d in args.kw_defaults if d is not None),
+                    ):
+                        yield sub
+                        yield from walk_import_time(sub)
+                    continue
+                yield child
+                yield from walk_import_time(child)
+
+        for node in walk_import_time(tree):
+            if is_env_read(node):
+                flag(node, "environment read at import time pins the "
+                           "value for the process (tests/bench setting "
+                           "it later silently no-op); read at call time "
+                           "or through an accessor")
 
     # -- wall clock + RNG --------------------------------------------------
 

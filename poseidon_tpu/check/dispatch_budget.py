@@ -1,0 +1,232 @@
+"""dispatch-budget: every jitted kernel in ops/ must have warm-up coverage.
+
+The planner's ``precompile()`` walks every compile key production rounds
+can request, so the first real round never pays multi-second XLA
+compiles through the TPU tunnel (PR 3: two silent fresh compiles were
+the bulk of a "solver-bound" 15.2 s gang round).  That guarantee only
+holds while every jitted definition in ``poseidon_tpu/ops/`` stays
+*reachable* from the precompile path — a new kernel wired into a round
+path but not into precompile ships exactly the failure mode PR 3 dug
+out by hand.
+
+This is the suite's first *project-scoped* rule: ``check()`` collects
+per-file facts (function definitions, name references, jitted defs) for
+every scanned file, and ``finalize()`` — called once after the walk —
+computes a name-based transitive closure from every ``precompile``
+function/method seen, then flags jitted defs under ``poseidon_tpu/ops/``
+outside the closure.
+
+The closure is deliberately an over-approximation (any Load of a name,
+any attribute tail, joins the graph): a false "covered" verdict is
+possible, a false finding on genuinely-wired code is not — the gate
+stays quiet on the live tree and only fires on kernels nothing
+references.  Three escape hatches:
+
+- scanning a path set that contains no ``precompile`` definition (e.g.
+  ``--rule dispatch-budget`` on one kernel file) disables the rule —
+  reachability cannot be judged on a partial graph;
+- explicit file-list scans (``--changed``, ``check a.py b.py``) never
+  judge: only files under a DIRECTORY scan root are flagged, because a
+  file list that happens to include ``precompile`` can still miss the
+  intermediate file that wires a kernel in (``begin()`` records the
+  roots);
+- a deliberately dispatch-time-compiled kernel carries the standard
+  line suppression ``# posecheck: ignore[dispatch-budget]`` on its
+  ``def`` line, which is the explicit opt-out the review trail can see.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Set
+
+from poseidon_tpu.check.core import (
+    Finding,
+    Rule,
+    dotted_name,
+    suppressions,
+)
+from poseidon_tpu.check.jit_purity import (
+    _is_jit_expr,
+    _jit_names,
+    _partial_names,
+)
+
+
+@dataclass
+class _FileFacts:
+    path: str
+    # function/method name -> referenced names (Loads + attribute tails)
+    refs: Dict[str, Set[str]] = field(default_factory=dict)
+    # jitted defs in this file: name -> def lineno
+    jitted: Dict[str, int] = field(default_factory=dict)
+    # names this file defines (functions and methods, unqualified)
+    defs: Set[str] = field(default_factory=set)
+    # lines with a posecheck suppression covering this rule
+    suppressed_lines: Set[int] = field(default_factory=set)
+
+
+def _referenced_names(fn: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            names.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # self._dispatch_solve / transport.solve_transport: the tail
+            # is the edge.  Over-approximate: any same-named function in
+            # the scanned set joins the closure.
+            names.add(node.attr)
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                names.add(a.name)
+    return names
+
+
+class DispatchBudgetRule(Rule):
+    name = "dispatch-budget"
+    # Empty scopes: facts are collected from EVERY scanned file (the
+    # precompile seeds live in graph/ and replay/); only jitted defs
+    # under _FLAG_FRAGMENT are ever flagged.
+    scopes: tuple = ()
+
+    _SEED_NAMES = ("precompile",)
+
+    def __init__(self, flag_fragments=("poseidon_tpu/ops/",)) -> None:
+        # Jitted defs are only FLAGGED in files matching these fragments
+        # (facts still collect everywhere); the selfcheck tests narrow
+        # this to the fixtures directory.
+        self._flag_fragments = tuple(flag_fragments)
+        self._files: List[_FileFacts] = []
+        # Directory scan roots from begin(): None = no restriction (the
+        # check_file/finalize path the selfcheck tests drive directly).
+        self._dir_roots = None
+
+    def begin(self, paths) -> None:
+        # A reachability verdict is only sound over a COMPLETE reference
+        # graph.  Explicit file lists (--changed, `check a.py b.py`) see
+        # a partial graph — a kernel wired via an un-listed file would
+        # false-flag — so only files under directory scan roots are ever
+        # judged; a pure file-list scan judges nothing.  (The seed guard
+        # below is not enough on its own: {instance.py, transport_fused.py}
+        # contains precompile yet misses the wiring in transport.py.)
+        from pathlib import Path
+
+        self._dir_roots = [
+            Path(p).resolve() for p in paths if Path(p).is_dir()
+        ]
+
+    def check(self, tree: ast.AST, source: str, path: str) -> List[Finding]:
+        assert isinstance(tree, ast.Module)
+        jit = _jit_names(tree)
+        partials = _partial_names(tree)
+        facts = _FileFacts(path=path)
+
+        supp = suppressions(source)
+        for lineno, rules in supp.items():
+            if rules is None or self.name in rules:
+                facts.suppressed_lines.add(lineno)
+
+        def visit_function(fn: ast.FunctionDef) -> None:
+            facts.defs.add(fn.name)
+            facts.refs.setdefault(fn.name, set()).update(
+                _referenced_names(fn)
+            )
+            if any(
+                _is_jit_expr(d, jit, partials) for d in fn.decorator_list
+            ):
+                facts.jitted[fn.name] = fn.lineno
+
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                visit_function(node)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, ast.FunctionDef):
+                        visit_function(sub)
+            elif isinstance(node, ast.Assign):
+                # g = jax.jit(f) / g = partial(jax.jit, ...)(f): the
+                # wrapper name is the jitted def; the wrapped function
+                # is reachable whenever the wrapper is.
+                v = node.value
+                if (
+                    isinstance(v, ast.Call)
+                    and _is_jit_expr(v.func, jit, partials)
+                    and v.args
+                ):
+                    inner = dotted_name(v.args[0])
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            facts.defs.add(t.id)
+                            facts.jitted[t.id] = node.lineno
+                            if inner and "." not in inner:
+                                facts.refs.setdefault(
+                                    t.id, set()
+                                ).add(inner)
+        self._files.append(facts)
+        return []
+
+    def _judgeable(self, path: str) -> bool:
+        if self._dir_roots is None:
+            return True
+        from pathlib import Path
+
+        try:
+            resolved = Path(path).resolve()
+        except OSError:
+            return False
+        return any(
+            root == resolved or root in resolved.parents
+            for root in self._dir_roots
+        )
+
+    def finalize(self) -> List[Finding]:
+        files, self._files = self._files, []
+        all_refs: Dict[str, Set[str]] = {}
+        defined: Set[str] = set()
+        for f in files:
+            defined.update(f.defs)
+            for name, refs in f.refs.items():
+                all_refs.setdefault(name, set()).update(refs)
+
+        seeds = [
+            s for s in self._SEED_NAMES
+            if any(s in f.defs for f in files)
+        ]
+        if not seeds:
+            # Partial graph (single-file / kernel-only invocation):
+            # reachability is not judgeable, stay silent.
+            return []
+
+        reached: Set[str] = set()
+        frontier = list(seeds)
+        while frontier:
+            name = frontier.pop()
+            if name in reached:
+                continue
+            reached.add(name)
+            for ref in all_refs.get(name, ()):
+                if ref in defined and ref not in reached:
+                    frontier.append(ref)
+
+        findings: List[Finding] = []
+        for f in files:
+            if not any(frag in f.path for frag in self._flag_fragments):
+                continue
+            if not self._judgeable(f.path):
+                continue
+            for name, lineno in sorted(f.jitted.items()):
+                if name in reached or lineno in f.suppressed_lines:
+                    continue
+                findings.append(Finding(
+                    f.path, lineno, self.name,
+                    f"jitted `{name}` is not reachable from the "
+                    "precompile path: its first production dispatch "
+                    "pays a fresh XLA compile (wire it into "
+                    "precompile(), or opt out with "
+                    "`# posecheck: ignore[dispatch-budget]` plus a "
+                    "justification)",
+                ))
+        findings.sort(key=lambda x: (x.path, x.line))
+        self._dir_roots = None
+        return findings

@@ -1,9 +1,15 @@
-"""determinism clean fixture: seeded RNG streams, virtual time, and
-sorted iteration over sets."""
+"""determinism clean fixture: seeded RNG streams, virtual time, sorted
+iteration over sets, and call-time environment reads."""
 
+import os
 import time
 
 import numpy as np
+
+
+def unroll_factor() -> int:
+    # Call-time accessor: tests/bench can vary the env var per call.
+    return int(os.environ.get("FIXTURE_UNROLL", "4"))
 
 
 def seeded_trace(seed: int):

@@ -7,14 +7,33 @@ Expected findings:
   - iteration over bare sets                     (5: for / comprehension /
                                                   list() / tracked var /
                                                   var grown via |=)
+  - import-time environment reads                (4: .get / subscript /
+                                                  class body / def default)
   - suppressed time.time() does NOT count
 """
 
+import os
 import random
 import time
 from time import time as now
 
 import numpy as np
+
+UNROLL = int(os.environ.get("FIXTURE_UNROLL", "4"))   # VIOLATION: import-time
+MODE = os.environ["FIXTURE_MODE"]                     # VIOLATION: import-time
+
+
+class Tunables:
+    budget = int(os.getenv("FIXTURE_BUDGET", "8"))    # VIOLATION: class body
+
+    def call_time(self):
+        return os.environ.get("FIXTURE_BUDGET", "8")  # call time: fine
+
+
+def pinned_default(                                   # default evaluates at
+    n=int(os.environ.get("FIXTURE_N", "4")),          # VIOLATION: import
+):
+    return n
 
 
 def stamp_events(events):

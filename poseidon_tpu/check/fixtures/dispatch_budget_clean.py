@@ -1,0 +1,32 @@
+"""dispatch-budget clean fixture: every jitted def has warm-up coverage.
+
+``precompile`` reaches both kernels — one through a host wrapper (the
+``solve_transport`` shape), one directly.  Zero findings expected.
+"""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("scale",))
+def kernel(x, *, scale):
+    return x * scale
+
+
+def _plain(x):
+    return x + 1
+
+
+wrapped = jax.jit(_plain)
+
+
+def solve(x):
+    """Host wrapper around the dispatch (the solve_transport shape)."""
+    return kernel(x, scale=4)
+
+
+def precompile():
+    """Warm every compile key the round paths can request."""
+    solve(0)
+    return wrapped(0)

@@ -1,0 +1,40 @@
+"""dispatch-budget violation fixture: jitted defs without warm-up.
+
+Expected findings (tests/test_check_selfcheck.py asserts these):
+  - ``uncovered_kernel``: decorated jit precompile never reaches   (1)
+  - ``wrapper_orphan``: module-level jit wrapper nothing references (1)
+  - ``covered_kernel`` is reached through precompile: no finding
+  - ``opted_out`` carries the explicit suppression: no finding
+"""
+
+import functools
+
+import jax
+
+
+@jax.jit
+def covered_kernel(x):
+    return x + 1
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def uncovered_kernel(x, *, n):
+    # VIOLATION: no path from precompile() reaches this kernel — its
+    # first production dispatch pays a fresh XLA compile.
+    return x * n
+
+
+def _plain(x):
+    return x
+
+
+wrapper_orphan = jax.jit(_plain)  # VIOLATION: orphaned jit wrapper
+
+
+@jax.jit
+def opted_out(x):  # posecheck: ignore[dispatch-budget]
+    return x - 1
+
+
+def precompile():
+    return covered_kernel(0)

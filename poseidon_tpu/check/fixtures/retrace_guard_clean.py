@@ -1,0 +1,67 @@
+"""retrace-guard clean fixture: the sanctioned jit-boundary patterns.
+
+Module-level jit definitions (process-lived compile cache), varying
+counts normalized through a padding-bucket helper before they become
+shapes, strings/bools bound only to ``static_argnames`` parameters, and
+plain ints at traced positions.  Zero findings expected.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bucket_size(n: int, lo: int = 32) -> int:
+    """Stand-in for the transport padding helper: quantized extents."""
+    if n <= lo:
+        return lo
+    return 1 << (n - 1).bit_length()
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "mode"))
+def kernel(x, eps, *, scale, mode="dense"):
+    del mode
+    return x * scale + eps
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def kernel_nums(x, mode):
+    del mode
+    return x
+
+
+def nums_call(xs):
+    # Position 1 is static via static_argnums (resolved through the
+    # signature): a str constant here is the sanctioned pattern.
+    return kernel_nums(jnp.asarray(xs), "fast")
+
+
+# Module-level wrapper: the cache lives as long as the process.
+warm = jax.jit(lambda x: x * 2)
+
+# Wrapper around a function DEFINED ELSEWHERE with static names: the
+# positional binding happens through a signature this module cannot
+# see, so the rule must not guess static-vs-traced for positionals.
+wrapped_ext = jax.jit(np.argsort, static_argnames=("kind",))
+
+
+def ext_positional(xs):
+    return wrapped_ext(xs, "stable")
+
+
+def padded_call(xs):
+    # len() is fine when it feeds the padding helper: the bucketed
+    # extent is the compile key, not the raw count.
+    m_pad = bucket_size(len(xs))
+    buf = np.zeros(m_pad, dtype=np.int32)
+    buf[: len(xs)] = xs
+    # A str bound to a static_argnames parameter is the sanctioned way
+    # to select a code path per compile key.
+    return kernel(buf, 0, scale=4, mode="dense")
+
+
+def traced_scalars(xs, budget):
+    # Python ints trace as int32 operands without minting compile keys.
+    return kernel(jnp.asarray(xs), budget, scale=8)

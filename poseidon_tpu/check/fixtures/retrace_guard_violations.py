@@ -1,0 +1,90 @@
+"""retrace-guard violation fixture: every retrace-hazard class, seeded.
+
+Expected findings (tests/test_check_selfcheck.py asserts these):
+  - jit constructed inside a function / loop / nested def /
+    class method / module-level loop (bare + if-gated)        (6)
+  - static_argnames argument derived from len()               (1)
+  - str constant at a traced position                         (1)
+  - bool constant at a traced position                        (1)
+  - unpadded len()-shaped array at the jit boundary           (1)
+  - Python float literal at a traced position                 (1)
+  - suppressed float literal does NOT count
+"""
+
+import functools
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("scale",))
+def kernel(x, eps, *, scale):
+    return x * scale + eps
+
+
+def _inner(x, mode):
+    return x
+
+
+loose = jax.jit(_inner)
+
+_WARMED = []
+for _size in (8, 16):
+    _WARMED.append(jax.jit(_inner))       # VIOLATION: jit in module loop
+
+if len(_WARMED) < 4:
+    for _size in (32, 64):
+        # VIOLATION: gating the warm-up loop behind an `if` is still a
+        # per-iteration wrapper mint.
+        _WARMED.append(jax.jit(_inner))
+
+
+class RoundDriver:
+    def drive(self, xs):
+        return jax.jit(_inner)(xs, 0)     # VIOLATION: per-call jit, method
+
+
+def fresh_cache_per_call(xs):
+    f = jax.jit(_inner)                   # VIOLATION: per-call jit cache
+    return f(xs, 0)
+
+
+def fresh_cache_in_loop(xs):
+    out = []
+    for x in xs:
+        g = partial(jax.jit, static_argnames=())(_inner)  # VIOLATION
+        out.append(g(x, 0))
+    return out
+
+
+def nested_jit(xs):
+    @jax.jit                              # VIOLATION: nested-def cache
+    def h(x):
+        return x + 1
+
+    return h(xs)
+
+
+def varying_static(xs):
+    return kernel(xs, 0, scale=len(xs))   # VIOLATION: retrace per value
+
+
+def str_at_traced(xs):
+    return loose(xs, "fast")              # VIOLATION: dropped static entry
+
+
+def bool_at_traced(xs):
+    return loose(xs, mode=True)           # VIOLATION: dropped static entry
+
+
+def unpadded_shape(xs):
+    return loose(np.zeros(len(xs)), 0)    # VIOLATION: shape-varying array
+
+
+def weak_float(xs):
+    return kernel(xs, 0.5, scale=2)       # VIOLATION: weak-type promotion
+
+
+def suppressed_float(xs):
+    return kernel(xs, 1.5, scale=2)  # posecheck: ignore[retrace-guard]

@@ -1,0 +1,209 @@
+"""Runtime compile ledger: assert an exact fresh-XLA-compile budget.
+
+The static rules (``retrace-guard``, ``dispatch-budget``) catch the
+*patterns* that mint compile keys; this module catches the *events*.
+PR 3's "solver-bound" 15.2 s gang round was two silent fresh compiles
+plus a poisoned warm start — invisible in every latency metric except
+wall time, found only by a manual profiling session.  The ledger makes
+"zero fresh compiles in a warm round" a cheap, permanent regression
+gate instead of hard-won tribal knowledge.
+
+Two layers:
+
+- ``fresh_compile_count()``: a process-wide monotonic counter of
+  backend (XLA) compiles, fed by a ``jax.monitoring`` duration-event
+  listener.  Callers difference it around a window, exactly like
+  ``transport.device_call_count()`` — ``RoundMetrics.fresh_compiles``
+  and the bench sub-reports are wired this way.
+- ``CompileLedger``: a context manager wrapping a window in an exact
+  budget.  On exit, ``fresh_compiles > budget`` raises
+  ``CompileBudgetExceeded`` naming the programs that compiled (captured
+  from ``jax.log_compiles`` while the window is open), so the failure
+  message says *what* retraced, not just that something did.
+
+The listener counts ``/jax/core/compile/backend_compile_duration``
+events: one per fresh XLA executable, helper programs included
+(``jnp.ones`` and friends are their own tiny jit programs), and zero
+for compile-cache hits — which is the correct strictness for a warm
+window, where *nothing* should compile.  Tracing-only work (a jaxpr
+re-trace that hits the executable cache) is surfaced separately via
+``retraces`` for diagnostics but never counted against the budget.
+
+Listener registration is lazy (first use) and permanent:
+``jax.monitoring`` offers no single-listener deregistration, so one
+module-level hook dispatches to whatever ledgers are active — cheap
+enough (an int bump on a compile, which costs milliseconds anyway) to
+leave installed.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+from typing import List, Optional
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+
+# "Compiling <name> with global shapes and types [...]" — the pxla log
+# line emitted under jax.log_compiles(True); the payload that turns a
+# budget failure into an actionable message.
+_COMPILING_RE = re.compile(r"Compiling (\S+) with global shapes")
+
+_lock = threading.Lock()
+_installed = False
+_compile_count = 0
+_trace_count = 0
+_active: List["CompileLedger"] = []
+
+
+def _listener(event: str, duration: float, **kwargs) -> None:
+    global _compile_count, _trace_count
+    if event == _COMPILE_EVENT:
+        with _lock:
+            _compile_count += 1
+            for led in _active:
+                led._fresh += 1
+    elif event == _TRACE_EVENT:
+        with _lock:
+            _trace_count += 1
+            for led in _active:
+                led._retraces += 1
+
+
+def _ensure_listener() -> None:
+    global _installed
+    if _installed:
+        return
+    with _lock:
+        if _installed:
+            return
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(_listener)
+        _installed = True
+
+
+def fresh_compile_count() -> int:
+    """Process-wide count of fresh XLA backend compiles since the first
+    ledger/counter use.  Difference around a window (a scheduling round,
+    a bench config) the same way ``device_call_count`` is used."""
+    _ensure_listener()
+    return _compile_count
+
+
+def retrace_count() -> int:
+    """Process-wide count of jaxpr traces (diagnostic companion to
+    ``fresh_compile_count``: a climbing trace count with a flat compile
+    count means retracing into a warm executable cache)."""
+    _ensure_listener()
+    return _trace_count
+
+
+class CompileBudgetExceeded(AssertionError):
+    """A ledger window compiled more fresh XLA programs than budgeted."""
+
+
+class _NameCapture(logging.Handler):
+    def __init__(self, sink: List[str]):
+        super().__init__(level=logging.DEBUG)
+        self._sink = sink
+
+    def emit(self, record: logging.LogRecord) -> None:
+        m = _COMPILING_RE.search(record.getMessage())
+        if m:
+            self._sink.append(m.group(1))
+
+
+class CompileLedger:
+    """Context manager asserting an exact fresh-compile budget.
+
+    >>> with CompileLedger(budget=0, label="warm gang round"):
+    ...     planner.schedule_round()
+
+    ``budget=None`` records without asserting (telemetry mode).  The
+    assertion is raised from ``__exit__`` only when the body itself did
+    not raise — a real failure inside the window must not be masked by
+    the budget report.
+    """
+
+    # The logger whose "Compiling <name> ..." records identify fresh
+    # programs under jax.log_compiles; the dispatch logger carries the
+    # noisy per-stage "Finished ..." lines that must not leak to stderr
+    # while the window holds log_compiles open.
+    _PXLA_LOGGER = "jax._src.interpreters.pxla"
+    _QUIET_LOGGERS = (_PXLA_LOGGER, "jax._src.dispatch")
+
+    def __init__(self, budget: Optional[int] = 0, label: str = ""):
+        self.budget = budget
+        self.label = label
+        self._fresh = 0
+        self._retraces = 0
+        self.compiled_names: List[str] = []
+        self._log_ctx = None
+        self._handler: Optional[_NameCapture] = None
+        self._prev_propagate: dict = {}
+
+    # -- telemetry ---------------------------------------------------------
+
+    @property
+    def fresh_compiles(self) -> int:
+        return self._fresh
+
+    @property
+    def retraces(self) -> int:
+        return self._retraces
+
+    # -- context protocol --------------------------------------------------
+
+    def __enter__(self) -> "CompileLedger":
+        _ensure_listener()
+        import jax
+
+        # Capture compiled-program names while the window is open; the
+        # pxla/dispatch loggers normally propagate to root at WARNING
+        # under log_compiles, which would spam test output — disable
+        # propagation for the window and restore on exit.
+        # The handler goes on EVERY quieted logger (the regex only
+        # matches pxla's "Compiling ..." lines): with propagation off, a
+        # logger with no handler would fall through to logging's
+        # lastResort stderr handler, defeating the quieting.
+        self._handler = _NameCapture(self.compiled_names)
+        for name in self._QUIET_LOGGERS:
+            lg = logging.getLogger(name)
+            lg.addHandler(self._handler)
+            self._prev_propagate[name] = lg.propagate
+            lg.propagate = False
+        self._log_ctx = jax.log_compiles(True)
+        self._log_ctx.__enter__()
+        with _lock:
+            _active.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        with _lock:
+            if self in _active:
+                _active.remove(self)
+        if self._log_ctx is not None:
+            self._log_ctx.__exit__(exc_type, exc, tb)
+            self._log_ctx = None
+        for name, prev in self._prev_propagate.items():
+            lg = logging.getLogger(name)
+            if self._handler is not None:
+                lg.removeHandler(self._handler)
+            lg.propagate = prev
+        self._handler = None
+        self._prev_propagate = {}
+        if exc_type is None and self.budget is not None \
+                and self._fresh > self.budget:
+            where = f" in {self.label}" if self.label else ""
+            names = ", ".join(self.compiled_names) or "<names not captured>"
+            raise CompileBudgetExceeded(
+                f"{self._fresh} fresh XLA compile(s){where}, budget "
+                f"{self.budget}; compiled: {names}.  A warm path minted "
+                "new compile keys — look for shape/dtype/static-arg "
+                "drift at the jit boundary (posecheck retrace-guard "
+                "names the static patterns)."
+            )
+        return False

@@ -1,0 +1,361 @@
+"""retrace-guard: static recompile/dispatch-hazard analysis at jit boundaries.
+
+Scope: ``poseidon_tpu/ops/`` and ``poseidon_tpu/graph/`` — the solver
+kernels and the round planner that feeds them.  PR 3's headline finding
+was that a "solver-bound" 15.2 s gang round was really two silent fresh
+XLA compiles plus a poisoned warm start, found only by a manual
+profiling session; this rule makes that bug class a lint failure.  Four
+hazard patterns, all of which mint fresh compile keys (or silently
+promote dtypes) without any visible code smell at the call site:
+
+- **local jit construction**: ``jax.jit(...)`` / ``functools.partial(
+  jax.jit, ...)`` evaluated inside a function or loop builds a *fresh
+  compile cache per call* — every invocation retraces and recompiles,
+  no matter how stable the shapes are.  Jitted callables must be
+  module-level (decorator or module-level assignment), where the cache
+  is process-lived.
+- **non-array constant at a traced position**: a ``str``/``bool``
+  literal passed to a jitted callable in a parameter *not* listed in
+  ``static_argnames``.  This is exactly what dropping a
+  ``static_argnames`` entry looks like from the call site: the value
+  either fails to trace (str) or traces as a weak-typed array whose
+  Python-level branch uses then crash — and on signatures that survive,
+  each distinct value mints a fresh executable.
+- **instance-varying static argument**: an argument bound to a
+  ``static_argnames`` entry whose expression derives from ``len(...)``
+  or ``.shape`` — a per-round-varying Python value used as a compile
+  key retraces *per value* (the round-2 churn storm), where a padded
+  bucket (``bucket_size`` / ``padded_shape``) holds the key fixed.
+- **unpadded shape at the boundary**: an array constructed with a raw
+  ``len(...)``-derived extent (``np.zeros(len(xs))``) passed straight
+  to a jitted callable.  Shapes are compile keys; the padding-bucket
+  helpers in ``ops/transport.py`` / ``graph/instance.py`` exist so
+  per-round count churn lands on a small fixed set of padded sizes.
+- **weak-type float at the boundary**: a Python float literal (or a
+  ``float(...)``/``np.float64(...)`` cast) passed as a traced argument.
+  jax types it as a weak float, which both mints a compile key distinct
+  from the int32 planes everything else carries *and* silently promotes
+  the arithmetic it touches (wrong dtype in the cost planes, then a
+  second retrace when an int32 path reappears).
+
+Detection reuses the jit-discovery machinery from ``jit_purity``:
+module-level defs decorated with ``jax.jit`` / ``partial(jax.jit,
+...)`` and module-level ``g = jax.jit(f)`` wrappers are the known jit
+boundary; their ``static_argnames`` tuples are parsed from the
+decorator/wrapper so call-site arguments can be classified
+static-vs-traced through the actual signature.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from poseidon_tpu.check.core import Finding, Rule, dotted_name
+from poseidon_tpu.check.jit_purity import (
+    _is_jit_expr,
+    _jit_names,
+    _partial_names,
+)
+
+# Call names that normalize a varying count onto a fixed compile bucket;
+# a len()/.shape occurrence under one of these is the sanctioned pattern,
+# not a hazard.  Matched on the trailing identifier so both
+# ``bucket_size`` and ``transport.bucket_size`` qualify.
+_PADDING_HELPERS = ("bucket_size", "padded_shape")
+
+
+def _is_padding_call(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    if not name:
+        return False
+    tail = name.split(".")[-1]
+    return tail in _PADDING_HELPERS or "pad" in tail
+
+
+def _contains_varying(node: ast.AST) -> bool:
+    """Does this expression derive from len(...) or .shape, outside any
+    padding-helper call?"""
+    if isinstance(node, ast.Call):
+        if _is_padding_call(node):
+            return False
+        if isinstance(node.func, ast.Name) and node.func.id == "len":
+            return True
+    if isinstance(node, ast.Attribute) and node.attr == "shape":
+        return True
+    return any(_contains_varying(c) for c in ast.iter_child_nodes(node))
+
+
+# Array constructors whose first argument is a shape: a raw varying
+# extent here puts a per-round shape on the compile key.
+_SHAPE_CTORS = ("zeros", "ones", "full", "empty", "arange")
+
+
+def _unpadded_shape_ctor(node: ast.AST) -> Optional[ast.Call]:
+    """First array-constructor call in the expression whose shape
+    argument varies unpadded, else None."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = dotted_name(sub.func)
+        if not name or name.split(".")[-1] not in _SHAPE_CTORS:
+            continue
+        if sub.args and _contains_varying(sub.args[0]):
+            return sub
+    return None
+
+
+def _weak_float_expr(node: ast.AST) -> bool:
+    """Is this expression a Python-float-valued literal or cast?"""
+    if isinstance(node, ast.Constant) and isinstance(node.value, float):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return _weak_float_expr(node.operand)
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        if name and name.split(".")[-1] in ("float", "float64", "float32"):
+            return True
+    return False
+
+
+def _static_spec(call: ast.Call) -> Tuple[Set[str], Set[int], bool]:
+    """``(names, positional indices, unparseable)`` from the
+    ``static_argnames`` / ``static_argnums`` keywords of a
+    ``jax.jit(...)`` / ``partial(jax.jit, ...)`` call.  A spec built
+    from a variable or comprehension (not constants) is ``unparseable``
+    — the def is then treated as opaque and its call sites are never
+    judged, because guessing static-vs-traced there guarantees false
+    positives one way or the other."""
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    unparseable = False
+    for kw in call.keywords:
+        if kw.arg not in ("static_argnames", "static_argnums"):
+            continue
+        v = kw.value
+        elts = (
+            list(v.elts) if isinstance(v, (ast.Tuple, ast.List, ast.Set))
+            else [v]
+        )
+        for e in elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                names.add(e.value)
+            elif isinstance(e, ast.Constant) and isinstance(e.value, int):
+                nums.add(e.value)
+            else:
+                unparseable = True
+    return names, nums, unparseable
+
+
+class _JitDef:
+    """A module-level jitted callable: its signature (when the wrapped
+    def is in this module) and its static parameter set."""
+
+    def __init__(
+        self,
+        fn: Optional[ast.FunctionDef],
+        static: Set[str],
+        static_nums: Set[int] = frozenset(),
+        opaque: bool = False,
+    ):
+        self.fn = fn
+        self.static = set(static)
+        self.opaque = opaque
+        self.params: List[str] = []
+        self.has_varargs = False
+        if fn is not None:
+            a = fn.args
+            self.params = [p.arg for p in a.posonlyargs + a.args]
+            self.has_varargs = a.vararg is not None
+        # static_argnums resolve to names through the signature; an
+        # index we cannot map (no signature, or out of range) makes the
+        # whole def opaque rather than mis-classified.
+        for i in static_nums:
+            if fn is not None and 0 <= i < len(self.params):
+                self.static.add(self.params[i])
+            else:
+                self.opaque = True
+
+    def param_for_pos(self, i: int) -> Optional[str]:
+        if i < len(self.params):
+            return self.params[i]
+        return None
+
+
+class RetraceGuardRule(Rule):
+    name = "retrace-guard"
+    scopes = ("poseidon_tpu/ops/", "poseidon_tpu/graph/")
+
+    def check(self, tree: ast.AST, source: str, path: str) -> List[Finding]:
+        assert isinstance(tree, ast.Module)
+        jit = _jit_names(tree)
+        partials = _partial_names(tree)
+
+        findings: List[Finding] = []
+
+        def flag(node: ast.AST, message: str) -> None:
+            findings.append(Finding(path, node.lineno, self.name, message))
+
+        # ---- known jit boundary: module-level defs + wrappers ----------
+        jit_defs: Dict[str, _JitDef] = {}
+        table: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)
+        }
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                for d in node.decorator_list:
+                    if _is_jit_expr(d, jit, partials):
+                        if isinstance(d, ast.Call):
+                            names, nums, opaque = _static_spec(d)
+                        else:
+                            names, nums, opaque = set(), set(), False
+                        jit_defs[node.name] = _JitDef(
+                            node, names, nums, opaque
+                        )
+                        break
+            elif isinstance(node, ast.Assign):
+                v = node.value
+                if (
+                    isinstance(v, ast.Call)
+                    and _is_jit_expr(v.func, jit, partials)
+                    and v.args
+                ):
+                    inner = dotted_name(v.args[0])
+                    names, nums, opaque = _static_spec(v)
+                    if isinstance(v.func, ast.Call):
+                        n2, m2, o2 = _static_spec(v.func)
+                        names |= n2
+                        nums |= m2
+                        opaque = opaque or o2
+                    fn = table.get(inner) if inner else None
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            jit_defs[t.id] = _JitDef(
+                                fn, names, nums, opaque
+                            )
+
+        # ---- hazard 1: jit constructed inside a function/loop ----------
+        # Walk function BODIES only: a module-level def's own
+        # `@partial(jax.jit, ...)` decorator is the sanctioned pattern,
+        # not a hazard (decorator nodes are children of the FunctionDef).
+        # Scan units: module-level functions, CLASS METHODS (the round
+        # planner in graph/ is almost entirely methods), and module-
+        # level loop bodies; nested defs are reached within their
+        # enclosing unit's walk.
+        units: List[ast.FunctionDef] = list(table.values())
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                units.extend(
+                    n for n in node.body if isinstance(n, ast.FunctionDef)
+                )
+        def scan_module_loops(node: ast.AST, in_loop: bool) -> None:
+            # Module-level statements outside any def/class, tracking
+            # loop context at ANY depth (a backend-gated `if:` around a
+            # warm-up loop is the realistic ops/ shape).  A conditional
+            # one-shot `g = jax.jit(f)` stays sanctioned — only
+            # constructions lexically inside a For/While flag.
+            if isinstance(
+                node,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                 ast.Lambda),
+            ):
+                return
+            if in_loop and isinstance(node, ast.Call) and _is_jit_expr(
+                node, jit, partials
+            ):
+                flag(node, "jit wrapper constructed inside a module-"
+                           "level loop mints a fresh compile cache "
+                           "per iteration; hoist out of the loop")
+                return
+            child_in_loop = in_loop or isinstance(
+                node, (ast.For, ast.While)
+            )
+            for child in ast.iter_child_nodes(node):
+                scan_module_loops(child, child_in_loop)
+
+        for stmt in tree.body:
+            scan_module_loops(stmt, False)
+        for fn in units:
+            for stmt in fn.body:
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Call) and _is_jit_expr(
+                        node, jit, partials
+                    ):
+                        flag(node, "jit wrapper constructed inside "
+                                   f"`{fn.name}()` mints a fresh compile "
+                                   "cache per call (retrace + recompile "
+                                   "every invocation); hoist to module "
+                                   "level")
+                    elif isinstance(node, ast.FunctionDef):
+                        # Call-shaped decorators (partial(jax.jit, ...))
+                        # are flagged by the Call branch above; this
+                        # covers the bare `@jax.jit` attribute form.
+                        for d in node.decorator_list:
+                            if not isinstance(d, ast.Call) and \
+                                    _is_jit_expr(d, jit, partials):
+                                flag(d, f"`@jit` on nested `{node.name}()` "
+                                        "builds a fresh compile cache per "
+                                        f"`{fn.name}()` call; hoist to "
+                                        "module level")
+
+        # ---- hazards 2-5: call sites of the known jit boundary ---------
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = (
+                node.func.id if isinstance(node.func, ast.Name) else None
+            )
+            if callee not in jit_defs:
+                continue
+            jd = jit_defs[callee]
+            if jd.opaque:
+                continue  # static spec unresolvable: never guess
+            bound: List[Tuple[Optional[str], ast.AST]] = []
+            for i, a in enumerate(node.args):
+                if isinstance(a, ast.Starred):
+                    continue
+                if jd.fn is None and jd.static:
+                    # Wrapper around a function defined elsewhere WITH
+                    # static names: jax binds positionals to those
+                    # names through the real signature, which we cannot
+                    # see — classifying them static-vs-traced would be
+                    # a guess, so positionals are skipped (keywords
+                    # still classify exactly by name).
+                    continue
+                bound.append((jd.param_for_pos(i), a))
+            for kw in node.keywords:
+                if kw.arg is not None:
+                    bound.append((kw.arg, kw.value))
+            for pname, value in bound:
+                is_static = pname is not None and pname in jd.static
+                where = f"`{callee}(... {pname or '<pos>'}=)`"
+                if is_static:
+                    if _contains_varying(value):
+                        flag(value, f"static argument {where} derives "
+                                    "from len()/.shape: a per-instance "
+                                    "value as a compile key retraces per "
+                                    "value; bucket it (bucket_size/"
+                                    "padded_shape) or make it traced")
+                    continue
+                if isinstance(value, ast.Constant) and isinstance(
+                    value.value, (str, bool)
+                ):
+                    flag(value, f"{type(value.value).__name__} constant "
+                                f"at traced position {where}: list the "
+                                "parameter in static_argnames (a dropped "
+                                "entry retraces or fails per value)")
+                    continue
+                if _weak_float_expr(value):
+                    flag(value, f"Python float at traced position {where} "
+                                "enters as a weak f32/f64: new compile "
+                                "key vs the int32 planes plus silent "
+                                "dtype promotion; use an int or an "
+                                "explicitly-dtyped array")
+                    continue
+                ctor = _unpadded_shape_ctor(value)
+                if ctor is not None:
+                    flag(ctor, f"array with raw len()/.shape-derived "
+                               f"extent reaches jit boundary {where}: "
+                               "per-round counts are compile keys; pad "
+                               "through bucket_size/padded_shape first")
+        return findings

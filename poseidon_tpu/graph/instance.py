@@ -80,6 +80,10 @@ class RoundMetrics:
     # call pays a host<->device round trip, so the count is a first-class
     # latency term alongside iterations.
     device_calls: int = 0
+    # Fresh XLA compiles this round (check/ledger.py counter diff): a
+    # warm steady-state round must report 0 — PR 3's 15.2 s "solver-
+    # bound" gang round was two of these hiding in solve wall time.
+    fresh_compiles: int = 0
     # Bellman-Ford sweeps spent inside the kernel's global updates — the
     # dominant per-iteration op-count term (tuning signal for
     # global_update_every / bf_max).
@@ -664,9 +668,11 @@ class RoundPlanner:
             self._collect_prior(view, mt)
 
         t_solve = time.perf_counter()
+        from poseidon_tpu.check.ledger import fresh_compile_count
         from poseidon_tpu.ops.transport import device_call_count
 
         calls0 = device_call_count()
+        fresh0 = fresh_compile_count()
         # Assignment pipelining: a finished band's EC->task assignment
         # (pure host work, ~0.5 s of a 10k fresh wave) runs on a worker
         # thread WHILE the next band's solve occupies the device — the
@@ -737,6 +743,7 @@ class RoundPlanner:
         # wrapper's full-solve fallback is two real device round trips,
         # and the host ssp path is zero.
         metrics.device_calls = device_call_count() - calls0
+        metrics.fresh_compiles = fresh_compile_count() - fresh0
         metrics.solve_seconds = time.perf_counter() - t_solve
         if metrics.gap_bound == float("inf"):
             # Even the cold retry exhausted its iteration budget: the

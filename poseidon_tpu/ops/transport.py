@@ -177,11 +177,24 @@ def _relabel_to(maxcand, has_adm, excess, p, eps):
 
 _DINF = 1 << 24  # "unreached" marker for global-update distances
 
-# Main-loop iterations per lax.while_loop step (see _pr_phase).  4 matches
-# the default global-update cadence so each group carries exactly one
-# global-update candidate slot.  Env-overridable for per-backend tuning
-# (read once at import: the value is baked into traced programs).
-ITER_UNROLL = int(os.environ.get("POSEIDON_ITER_UNROLL", "4"))
+def iter_unroll() -> int:
+    """Main-loop iterations per lax.while_loop step (see _pr_phase).
+
+    4 matches the default global-update cadence so each group carries
+    exactly one global-update candidate slot.  POSEIDON_ITER_UNROLL
+    overrides for per-backend tuning — read at CALL (trace) time, not
+    import time, so tests/bench can vary it per solve; note the value
+    is baked into each traced program, so a change takes effect on the
+    next fresh trace (new compile key or ``jax.clear_caches()``), never
+    by mutating an already-compiled executable.
+    """
+    try:
+        # int() of an env string at TRACE time, never of a tracer (the
+        # closure pulls this helper into jit scope via _pr_phase).
+        raw = os.environ.get("POSEIDON_ITER_UNROLL", "4")
+        return max(1, int(raw))  # posecheck: ignore[jit-purity]
+    except ValueError:
+        return 4
 
 
 def _global_update(F, Ffb, Fmt, pe, pm, pt, exc_e, exc_m, exc_t,
@@ -525,15 +538,17 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
             it + active.astype(jnp.int32), bf + sweeps,
         )
 
-    # ITER_UNROLL iterations per while step: on TPU each lax.while_loop
+    # iter_unroll() iterations per while step: on TPU each lax.while_loop
     # step pays a fixed sync/predicate cost that at small (churn/
     # selective) array sizes rivals the body itself; convergence and
     # budget checks re-run per sub-iteration via the `active` gate, so
     # arithmetic, budget semantics, and telemetry are all exact — the
-    # group merely runs up to ITER_UNROLL - 1 structurally-no-op
+    # group merely runs up to iter_unroll() - 1 structurally-no-op
     # sub-iterations at its tail, which costs device time only.
+    unroll = iter_unroll()
+
     def body(st):
-        for _ in range(ITER_UNROLL):
+        for _ in range(unroll):
             st = iterate(st)
         return st
 

@@ -172,7 +172,12 @@ def _greedy_seed_device(C, supply, capacity, arc_cap, unsched, scale,
     jax.jit,
     static_argnames=("groups", "block", "max_iter", "scale"),
 )
-def _chained_wave_device(
+# Deliberately outside precompile coverage: POSEIDON_CHAINED=1 is an
+# opt-in A/B path (chain_gate, default OFF pending live TPU evidence),
+# so its first qualifying wave pays the compile by design — warming it
+# for every production process would spend tunnel compile time on a
+# program ~nobody dispatches.  Re-judge if the default ever flips.
+def _chained_wave_device(  # posecheck: ignore[dispatch-budget]
     bigA, coarse3A, vecA, intB, utilsB, adm0B,
     *, groups, block, max_iter, scale,
 ):

@@ -38,9 +38,9 @@ from poseidon_tpu.ops.transport import (
     _NEG,
     _POS,
     INF_COST,
-    ITER_UNROLL,
     NUM_PHASES,
     _relabel_to,
+    iter_unroll,
 )
 
 # VMEM working-set gate, CALIBRATED ON LIVE v5e (2026-07-31 session):
@@ -418,8 +418,10 @@ def _phase_ladder_kernel(
                     it + active.astype(jnp.int32), bf + sweeps,
                 )
 
+            unroll = iter_unroll()
+
             def body(st):
-                for _ in range(ITER_UNROLL):
+                for _ in range(unroll):
                     st = iterate(st)
                 return st
 

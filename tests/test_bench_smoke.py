@@ -16,12 +16,21 @@ pytestmark = pytest.mark.slow
 def test_features_config_smoke():
     import bench
 
-    out = bench.run_features(200, rounds=1)
+    # rounds=2 so a WARM churn round exists: bench wraps it (and the
+    # gang round) in CompileLedger(budget=0), so a silent retrace in
+    # the warm path fails this test with the compiled program names —
+    # the runtime side of PR 3's zero-fresh-compiles invariant.
+    out = bench.run_features(200, rounds=2)
     assert out["ok"], out
 
     sel = out["selectors"]
     assert sel["violations"] == 0
     assert sel["zoned_placed"] == sel["zoned_total"] > 0
+    # The ledger-fed artifact columns: warm rounds compiled nothing.
+    assert len(sel["fresh_compiles"]) == 2
+    assert sel["warm_fresh_compiles"] == 0
+    assert out["pod_affinity"]["fresh_compiles"] == 0
+    assert out["gang"]["fresh_compiles"] == 0
 
     aff = out["pod_affinity"]
     assert aff["colocated"] == aff["targets"] > 0

@@ -1,0 +1,259 @@
+"""Compile-ledger tests: the runtime half of the retrace-guard story.
+
+The static rule (``retrace-guard``) flags the *patterns* that mint
+compile keys; ``CompileLedger`` catches the *events*.  The seeded-
+retrace tests here drive the same hazard through both layers — the AST
+rule flags the test-copy source, and the runtime ledger trips on the
+actual recompiles — so a regression in either detector fails tier-1.
+
+Planner integration (warm wave / gang rounds at toy scale) lives here
+too: an identical re-built instance scheduled by a fresh planner must
+compile nothing (the jit cache is process-wide), which is exactly the
+restart-warm production story.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poseidon_tpu.check import check_file, rules_by_name
+from poseidon_tpu.check.ledger import (
+    CompileBudgetExceeded,
+    CompileLedger,
+    fresh_compile_count,
+    retrace_count,
+)
+
+REPO = Path(__file__).parent.parent
+
+
+# ------------------------------------------------------------------ ledger
+
+
+@jax.jit
+def _toy_kernel(x):
+    return x * 2 + 1
+
+
+def test_counter_is_monotonic_and_counts_fresh_compiles():
+    base = fresh_compile_count()
+    _toy_kernel(jnp.arange(7, dtype=jnp.int32))  # cold at this shape
+    after_cold = fresh_compile_count()
+    assert after_cold >= base + 1
+    _toy_kernel(jnp.arange(7, dtype=jnp.int32))  # cache hit
+    assert fresh_compile_count() == after_cold
+
+
+def test_warm_window_passes_budget_zero():
+    _toy_kernel(jnp.arange(5, dtype=jnp.int32))
+    with CompileLedger(budget=0, label="warm toy") as led:
+        _toy_kernel(jnp.arange(5, dtype=jnp.int32))
+    assert led.fresh_compiles == 0
+
+
+def test_shape_drift_trips_budget_and_names_the_program():
+    _toy_kernel(jnp.arange(3, dtype=jnp.int32))
+    with pytest.raises(CompileBudgetExceeded, match="_toy_kernel"):
+        with CompileLedger(budget=0, label="drift"):
+            _toy_kernel(jnp.arange(11, dtype=jnp.int32))
+
+
+def test_telemetry_mode_records_without_asserting():
+    with CompileLedger(budget=None, label="telemetry") as led:
+        _toy_kernel(jnp.arange(13, dtype=jnp.int32))
+    assert led.fresh_compiles >= 1
+    assert "_toy_kernel" in led.compiled_names
+
+
+def test_body_exception_is_not_masked_by_budget_report():
+    with pytest.raises(ValueError, match="body failure"):
+        with CompileLedger(budget=0, label="masking"):
+            _toy_kernel(jnp.arange(17, dtype=jnp.int32))  # over budget
+            raise ValueError("body failure")
+
+
+def test_retrace_counter_moves_on_fresh_trace():
+    base = retrace_count()
+    _toy_kernel(jnp.arange(19, dtype=jnp.int32))
+    assert retrace_count() > base
+
+
+# --------------------------------------------- seeded retrace, both layers
+
+# A "test copy" of a production jit signature with the static_argnames
+# entry for `mode` DROPPED: the call site that used to be sanctioned
+# (str bound to a static parameter) is now a str at a traced position.
+_DROPPED_STATIC_SRC = '''
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("max_iter",))
+def solve(x, *, max_iter, mode):
+    return x * max_iter
+
+
+def round_path(x):
+    return solve(x, max_iter=64, mode="dense")
+'''
+
+
+def test_dropped_static_argname_trips_the_static_rule(tmp_path):
+    f = tmp_path / "dropped_static.py"
+    f.write_text(_DROPPED_STATIC_SRC)
+    found = check_file(
+        f, rules_by_name(["retrace-guard"]), forced=True, root=tmp_path
+    )
+    assert len(found) == 1
+    assert found[0].rule == "retrace-guard"
+    assert "str constant at traced position" in found[0].message
+    assert "static_argnames" in found[0].message
+
+
+def test_jit_in_loop_trips_the_static_rule(tmp_path):
+    f = tmp_path / "jit_in_loop.py"
+    f.write_text(
+        "import jax\n\n\n"
+        "def _kern(x):\n    return x + 1\n\n\n"
+        "def round_path(xs):\n"
+        "    return [jax.jit(_kern)(x) for x in xs]\n"
+    )
+    found = check_file(
+        f, rules_by_name(["retrace-guard"]), forced=True, root=tmp_path
+    )
+    assert len(found) == 1
+    assert "fresh compile cache per call" in found[0].message
+
+
+def test_seeded_retrace_trips_the_runtime_ledger():
+    """The runtime twin of the static findings above: a per-value
+    static argument retraces each round; a per-call jit wrapper
+    recompiles each round.  Both blow a zero budget on the WARM call."""
+
+    @jax.jit
+    def step(x, n):  # pretend n was meant to be static_argnames
+        return x + n
+
+    import functools
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def step_static(x, *, n):
+        return x + n
+
+    x = jnp.arange(8, dtype=jnp.int32)
+    step_static(x, n=1)  # cold: compile paid outside the window
+    with pytest.raises(CompileBudgetExceeded, match="step_static"):
+        with CompileLedger(budget=0, label="per-value static"):
+            # The retrace: a new static value mints a new executable on
+            # what the caller believes is a warm path.
+            step_static(x, n=2)
+
+    def round_path(v):
+        # The jit-in-function hazard: the wrapped callable is a fresh
+        # closure object per round, so the process-wide cache never
+        # hits — every round retraces AND recompiles.  (Re-wrapping
+        # the SAME function object would cache by identity; that is
+        # precisely why the static rule flags construction site, not
+        # call site.)
+        def _kern(u):
+            return u - 1
+
+        return jax.jit(_kern)(v)
+
+    round_path(x)  # a previous "round" already compiled this program
+    with pytest.raises(CompileBudgetExceeded):
+        with CompileLedger(budget=0, label="per-call jit wrapper"):
+            round_path(x)
+
+    # Sanity: the correctly-warm variants stay inside the budget.
+    step(x, jnp.int32(0))  # cold compile paid outside the window
+    with CompileLedger(budget=0, label="actually warm"):
+        step_static(x, n=1)
+        step(x, jnp.int32(3))
+        step(x, jnp.int32(4))  # traced operand: value churn is free
+
+
+# ------------------------------------------------- planner warm rounds
+
+
+def _toy_cluster(num_machines=12, num_tasks=48, gang=False):
+    from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+    from poseidon_tpu.utils.ids import generate_uuid, task_uid
+
+    st = ClusterState()
+    for i in range(num_machines):
+        st.node_added(MachineInfo(
+            uuid=generate_uuid(f"ldg-m{i}"), cpu_capacity=32000,
+            ram_capacity=128 << 20, task_slots=16,
+        ))
+    for i in range(num_tasks):
+        st.task_submitted(TaskInfo(
+            uid=task_uid("ldg", i),
+            job_id=f"ldg-gang-{i % 4}" if gang else f"ldg-{i % 4}",
+            cpu_request=500, ram_request=1 << 19, gang=gang,
+        ))
+    return st
+
+
+@pytest.mark.parametrize("gang", [False, True], ids=["wave", "gang"])
+def test_identical_rebuilt_round_is_compile_free(gang):
+    """Restart-warm contract: a fresh planner over an identically
+    rebuilt instance compiles nothing (process-wide jit cache), so a
+    warm wave/gang round is bit-for-bit budget-zero.  This is the test
+    harness twin of the bench's in-band gang/warm-round ledgers."""
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+
+    st1 = _toy_cluster(gang=gang)
+    p1 = RoundPlanner(st1, get_cost_model("cpu_mem"))
+    _, m1 = p1.schedule_round()  # cold: pays whatever compiles exist
+    assert m1.placed > 0
+
+    st2 = _toy_cluster(gang=gang)
+    p2 = RoundPlanner(st2, get_cost_model("cpu_mem"))
+    with CompileLedger(budget=0, label="rebuilt warm round") as led:
+        _, m2 = p2.schedule_round()
+    assert m2.placed == m1.placed
+    assert m2.objective == m1.objective
+    assert led.fresh_compiles == 0
+    # The RoundMetrics surface agrees with the ledger.
+    assert m2.fresh_compiles == 0
+
+
+def test_round_metrics_fresh_compiles_counts_cold_round():
+    """A planner solving a NEVER-SEEN padded shape must report its
+    fresh compiles in RoundMetrics — the per-round observability the
+    bench artifact columns ride on.  Machine count 97 pads to a 128
+    bucket no other test in this module uses at this EC bucket; numpy
+    churn in task count keeps the EC axis on a distinct bucket too."""
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+
+    rng = np.random.default_rng(7)
+    from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+    from poseidon_tpu.utils.ids import generate_uuid, task_uid
+
+    st = ClusterState()
+    for i in range(97):
+        st.node_added(MachineInfo(
+            uuid=generate_uuid(f"cold-m{i}"), cpu_capacity=32000,
+            ram_capacity=128 << 20, task_slots=16,
+        ))
+    for i in range(120):
+        st.task_submitted(TaskInfo(
+            uid=task_uid("cold", i), job_id=f"cold-{i % 24}",
+            cpu_request=int(rng.integers(100, 900)),
+            ram_request=1 << 19,
+        ))
+    planner = RoundPlanner(st, get_cost_model("cpu_mem"))
+    base = fresh_compile_count()
+    _, m = planner.schedule_round()
+    # Whatever compiled during the round is attributed to the round.
+    assert m.fresh_compiles == fresh_compile_count() - base
+    assert m.fresh_compiles >= 0

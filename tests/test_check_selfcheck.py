@@ -43,6 +43,7 @@ def _findings(rule: str, fixture: str):
         ("jit-purity", "jit_purity_clean.py"),
         ("lock-discipline", "lock_discipline_clean.py"),
         ("determinism", "determinism_clean.py"),
+        ("retrace-guard", "retrace_guard_clean.py"),
     ],
 )
 def test_clean_fixture_has_no_findings(rule, fixture):
@@ -84,11 +85,74 @@ def test_lock_discipline_violations():
 def test_determinism_violations():
     found = _findings("determinism", "determinism_violations.py")
     msgs = [f.message for f in found]
-    assert len(found) == 11
+    assert len(found) == 15
     assert sum("wall-clock" in m for m in msgs) == 2
     assert sum("unseeded global RNG" in m for m in msgs) == 3
     assert sum("without a seed" in m for m in msgs) == 1
     assert sum("unordered set" in m for m in msgs) == 5
+    assert sum("import time" in m for m in msgs) == 4
+
+
+def test_retrace_guard_violations():
+    found = _findings("retrace-guard", "retrace_guard_violations.py")
+    msgs = [f.message for f in found]
+    assert len(found) == 11
+    assert sum("fresh compile cache" in m for m in msgs) == 6
+    assert sum("module-level loop" in m for m in msgs) == 2
+    assert sum("drive" in m for m in msgs) == 1  # class-method hazard
+    assert sum("retraces per value" in m for m in msgs) == 1
+    assert sum("str constant at traced position" in m for m in msgs) == 1
+    assert sum("bool constant at traced position" in m for m in msgs) == 1
+    assert sum("pad through bucket_size" in m for m in msgs) == 1
+    assert sum("weak f32/f64" in m for m in msgs) == 1
+    # The suppressed float literal did not count.
+    assert all(f.rule == "retrace-guard" for f in found)
+
+
+def _dispatch_findings(fixture: str, flag_fragments=("check/fixtures",)):
+    from poseidon_tpu.check.dispatch_budget import DispatchBudgetRule
+
+    rule = DispatchBudgetRule(flag_fragments=flag_fragments)
+    pre = check_file(FIXTURES / fixture, [rule], forced=True, root=REPO)
+    assert pre == [], "dispatch-budget judges in finalize(), not check()"
+    return rule.finalize()
+
+
+def test_dispatch_budget_clean_fixture():
+    assert _dispatch_findings("dispatch_budget_clean.py") == []
+
+
+def test_dispatch_budget_violations():
+    found = _dispatch_findings("dispatch_budget_violations.py")
+    assert len(found) == 2
+    names = {f.message.split("`")[1] for f in found}
+    assert names == {"uncovered_kernel", "wrapper_orphan"}
+    # covered_kernel is reached; opted_out is line-suppressed.
+    assert all("precompile" in f.message for f in found)
+
+
+def test_dispatch_budget_silent_without_precompile_seed():
+    # A partial scan (no precompile def in sight) must not flag
+    # anything: reachability is not judgeable on a partial graph.
+    assert _dispatch_findings("jit_purity_violations.py") == []
+
+
+def test_dispatch_budget_never_judges_file_list_scans():
+    """A file list that happens to include precompile() is STILL a
+    partial graph: {instance.py, transport_fused.py} misses the wiring
+    in transport.py, and judging it would false-flag the fused kernel.
+    run() passes the scan paths through begin(); only directory roots
+    are judgeable."""
+    found = run(
+        [
+            str(REPO / "poseidon_tpu" / "graph" / "instance.py"),
+            str(REPO / "poseidon_tpu" / "ops" / "transport_fused.py"),
+        ],
+        root=REPO,
+    )
+    assert [f for f in found if f.rule == "dispatch-budget"] == []
+    # The directory walk DOES judge (and the live tree is wired clean).
+    assert run([str(REPO / "poseidon_tpu")], root=REPO) == []
 
 
 # ---------------------------------------------------------------- mechanics
@@ -133,6 +197,49 @@ def test_baseline_round_trip(tmp_path):
     assert moved.baseline_key() in keys
 
 
+def test_committed_baseline_is_empty_against_live_tree():
+    """Grandfathering is for downstream forks: THIS repo fixes findings
+    instead of baselining them, so the committed baseline must parse to
+    zero keys — and stay unnecessary (the live tree scans clean without
+    it, which test_repo_scans_clean enforces with no baseline at all)."""
+    committed = (
+        REPO / "poseidon_tpu" / "check" / "baseline.txt"
+    )
+    assert committed.exists()
+    assert load_baseline(committed) == set()
+
+
+def test_write_baseline_round_trips_violation_fixtures(tmp_path):
+    """--write-baseline over the seeded-violation fixtures must
+    grandfather every finding: the rewritten scan is clean, and
+    --no-baseline resurfaces the identical finding set."""
+    baseline = tmp_path / "fixture_baseline.txt"
+    fixtures = [
+        str(FIXTURES / "determinism_violations.py"),
+        str(FIXTURES / "retrace_guard_violations.py"),
+    ]
+    args = ["--rule", "determinism", "--rule", "retrace-guard"]
+    assert check_main(
+        [*args, "--write-baseline", "--baseline", str(baseline), *fixtures]
+    ) == 0
+    keys = load_baseline(baseline)
+    # Keys are (path, rule, message) — same-message findings on
+    # different lines collapse to one line-drift-immune entry.
+    assert len(keys) >= 10
+    assert any("retrace-guard" in k for k in keys)
+    assert any("determinism" in k for k in keys)
+    # Grandfathered: the same scan is now clean...
+    assert check_main(
+        [*args, "--baseline", str(baseline), *fixtures]
+    ) == 0
+    # ...and --no-baseline resurfaces exactly the written set.
+    resurfaced = run(
+        fixtures, rules=rules_by_name(["determinism", "retrace-guard"]),
+        root=REPO,
+    )
+    assert {f.baseline_key() for f in resurfaced} == keys
+
+
 def test_unknown_rule_is_usage_error(capsys):
     assert check_main(["--rule", "no-such-rule", "."]) == 2
     assert check_main(["poseidon_tpu/does/not/exist.py"]) == 2
@@ -172,6 +279,90 @@ def test_output_shape(capsys):
         assert path.endswith("determinism_violations.py")
         assert int(lineno) > 0
         assert rule == "determinism"
+
+
+def test_json_output_shape(capsys):
+    import json
+
+    rc = check_main(["--format=json", "--rule", "determinism",
+                     str(FIXTURES / "determinism_violations.py")])
+    assert rc == 1
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out, "violations must print"
+    for line in out:
+        obj = json.loads(line)  # one machine-parseable finding per line
+        assert set(obj) == {"path", "line", "rule", "message"}
+        assert obj["path"].endswith("determinism_violations.py")
+        assert obj["line"] > 0
+        assert obj["rule"] == "determinism"
+
+
+def test_changed_mode(tmp_path, monkeypatch, capsys):
+    """--changed scans only git-touched files: a committed-clean repo
+    scans nothing; touching a file with a violation surfaces it; a
+    non-repo directory is a usage error."""
+    import subprocess
+
+    repo = tmp_path / "repo"
+    repo.mkdir()
+
+    def git(*args):
+        subprocess.run(
+            ["git", *args], cwd=repo, check=True, capture_output=True,
+        )
+
+    git("init", "-q")
+    git("config", "user.email", "t@example.com")
+    git("config", "user.name", "t")
+    clean = "import numpy as np\n\n\ndef f(seed):\n" \
+            "    return np.random.default_rng(seed)\n"
+    (repo / "mod.py").write_text(clean)
+    git("add", "mod.py")
+    git("commit", "-q", "-m", "seed")
+
+    monkeypatch.chdir(repo)
+    # Nothing changed vs HEAD: clean exit, no scan.
+    assert check_main(["--changed", "--rule", "determinism", "."]) == 0
+    assert capsys.readouterr().out == ""
+
+    # An unstaged edit introduces a violation: --changed finds it.
+    (repo / "mod.py").write_text(
+        clean + "\n\ndef g():\n    return np.random.default_rng()\n"
+    )
+    assert check_main(["--changed", "--rule", "determinism", "."]) == 1
+    assert "without a seed" in capsys.readouterr().out
+
+    # An untracked new file counts as changed too.
+    (repo / "mod.py").write_text(clean)
+    (repo / "new.py").write_text(
+        "import time\n\n\ndef h():\n    return time.time()\n"
+    )
+    assert check_main(["--changed", "--rule", "determinism", "."]) == 1
+    assert "wall-clock" in capsys.readouterr().out
+    (repo / "new.py").unlink()
+
+    # From a SUBDIRECTORY: git prints toplevel-relative names, the scan
+    # paths are cwd-relative — tracked changes must still be found.
+    sub = repo / "sub"
+    sub.mkdir()
+    (sub / "inner.py").write_text(clean)
+    git("add", "sub/inner.py")
+    git("commit", "-q", "-m", "sub")
+    (sub / "inner.py").write_text(
+        clean + "\n\ndef g():\n    return np.random.default_rng()\n"
+    )
+    monkeypatch.chdir(sub)
+    assert check_main(["--changed", "--rule", "determinism", "."]) == 1
+    assert "without a seed" in capsys.readouterr().out
+    monkeypatch.chdir(repo)
+
+    # Outside any git checkout: usage error, not a silent no-op scan.
+    outside = tmp_path / "not_a_repo"
+    outside.mkdir()
+    (outside / "x.py").write_text("x = 1\n")
+    monkeypatch.chdir(outside)
+    monkeypatch.setenv("GIT_DIR", str(outside / "nope"))
+    assert check_main(["--changed", "--rule", "determinism", "."]) == 2
 
 
 # ------------------------------------------------------------------- repo

@@ -268,6 +268,21 @@ def test_gang_repair_multi_firing_pruned(monkeypatch):
     assert m.pruned_bands >= 1, "shortlist gate never fired"
 
 
+def test_gang_warm_round_is_compile_free():
+    """PR 3's invariant as a gang-path gate: a warm gang round — repair
+    firings and their hidden re-solves included — must mint ZERO fresh
+    XLA compiles.  Round 1 on an identical rebuilt cluster pays any
+    cold compiles; round 2 rides the compile ledger at budget 0 and
+    fails with the compiled program names if a retrace sneaks into the
+    repair path."""
+    from poseidon_tpu.check.ledger import CompileLedger
+
+    _run_multi_firing(_multi_firing_cluster())  # warm the compile keys
+    with CompileLedger(budget=0, label="warm gang multi-firing round"):
+        m = _run_multi_firing(_multi_firing_cluster())
+    assert m.fresh_compiles == 0
+
+
 def test_oversized_gang_places_nothing_on_pruned_path(monkeypatch):
     """A gang bigger than its admissible zone places nothing (atomicity)
     when the band solves on the pruned plane."""
