@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: all test test-fast bench protos native verify lint lint-fast \
-  demo demo-stop clean
+  bench-smoke soak-smoke demo demo-stop clean
 
 all: protos native lint test
 
@@ -26,6 +26,15 @@ bench-small:
 # semantics (zero violations, whole gangs), without the full bench.
 bench-smoke:
 	$(PY) -m pytest tests/test_bench_smoke.py -q -m slow -p no:cacheprovider
+
+# Chaos soak smoke (docs/CHAOS.md): the full glue+service stack at ~200
+# machines under a seeded fault plan covering every fault family —
+# gates zero state divergence per round, zero warm fresh compiles,
+# seed-reproducible placements, and the flight-recorder redrive path.
+# The recorder writes failure traces under out/soak/ (cleaned by
+# `make clean`).
+soak-smoke:
+	$(PY) -m pytest tests/test_soak_smoke.py -q -m slow -p no:cacheprovider
 
 protos:
 	$(PY) -m poseidon_tpu.protos.gen
@@ -57,8 +66,10 @@ lint:
 lint-fast:
 	$(PY) -m poseidon_tpu.check --changed poseidon_tpu/
 
-# Entry-point smoke: compile check + multichip dryrun + demo loop.
-verify: lint
+# Entry-point smoke: compile check + multichip dryrun + demo loop, with
+# the two behavior smokes (feature semantics + chaos robustness) gating
+# alongside static analysis.
+verify: lint bench-smoke soak-smoke
 	$(PY) __graft_entry__.py
 
 # Backgrounded demo loop with its PID on record (out/demo.pid), so the
@@ -82,4 +93,5 @@ demo-stop:
 
 clean: demo-stop
 	rm -f poseidon_tpu/native/_graphcore.so
+	rm -rf out/soak
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -749,6 +749,31 @@ def run_features(machines: int, rounds: int) -> dict:
     return out
 
 
+def run_soak(machines: int, rounds: int, plan: str, seed: int) -> dict:
+    """Soak mode: N rounds of the FULL glue+service stack under a named
+    fault plan (poseidon_tpu/chaos) at small scale, gating the
+    robustness claims — convergence, zero fake-kube/scheduler state
+    divergence after every round, zero fresh compiles on warm rounds,
+    and seed-reproducible placements.  A failure writes a flight-
+    recorder trace under out/soak/ that replay.redrive_flight re-drives
+    offline.  ``make soak-smoke`` runs this via tests/test_soak_smoke.py."""
+    from poseidon_tpu.chaos import run_soak as chaos_run_soak
+
+    out = chaos_run_soak(
+        machines=machines, rounds=rounds, plan=plan, seed=seed
+    )
+    # The determinism gate: a second run with the same seed must place
+    # identically (per-round placement digests compare equal).
+    if out.get("ok"):
+        rerun = chaos_run_soak(
+            machines=machines, rounds=rounds, plan=plan, seed=seed
+        )
+        out["deterministic"] = rerun.get("digests") == out.get("digests")
+        out["ok"] = bool(out["ok"] and rerun.get("ok")
+                         and out["deterministic"])
+    return out
+
+
 def run_parity() -> dict:
     """BASELINE config 1 (100 nodes / 1k pods): TPU solver objective must
     equal the exact host oracle on the same transportation instance."""
@@ -944,8 +969,11 @@ def main(argv=None) -> int:
     p.add_argument("--rounds", type=int, default=5)
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--child",
-                   choices=["rung", "parity", "trace", "features"],
+                   choices=["rung", "parity", "trace", "features", "soak"],
                    default=None)
+    p.add_argument("--plan", default="smoke",
+                   help="fault plan name for --child soak")
+    p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
 
     if args.child is not None:
@@ -971,6 +999,11 @@ def main(argv=None) -> int:
         return 0
     if args.child == "features":
         print(json.dumps(run_features(args.machines, args.rounds)))
+        return 0
+    if args.child == "soak":
+        print(json.dumps(run_soak(
+            args.machines or 200, max(args.rounds, 8), args.plan, args.seed
+        )))
         return 0
 
     # ---- parent: drive the stages; never touches jax (the probe runs in

@@ -1,0 +1,484 @@
+"""The soak harness: N rounds of the FULL stack under a fault plan.
+
+One soak = FakeKube (wrapped in ``ChaoticKube``) + the real pod/node
+watchers + the real gRPC firmament-tpu service + the real
+``FirmamentClient`` (fault-wrapped stubs) + the production schedule-loop
+failure policy (``Poseidon.try_round``), driven round by round with a
+seeded workload while the armed faults fire.  After EVERY round the
+harness asserts:
+
+- **zero state divergence**: the fake-kube truth (bound Running pods)
+  and the scheduler's view (RUNNING tasks' placements), joined through
+  the glue's id maps, are byte-identical;
+- **zero fresh XLA compiles on warm rounds** (the compile ledger,
+  check/ledger.py — the same invariant ``bench.run_features`` gates);
+- progress: the workload keeps placing (checked at the end: after the
+  fault window plus a short settle, every pod is Running).
+
+Determinism is the third gate: the whole soak — workload, fault plan,
+retry jitter — is seeded, so a re-run with the same spec produces the
+same per-round placement digests (``run_soak`` returns them; the smoke
+test compares two runs).
+
+On any failure the ``FlightRecorder`` writes a trace under ``out/soak/``
+that ``replay/flight.py`` re-drives offline to the identical failing
+round.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import time
+from dataclasses import asdict
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from poseidon_tpu.chaos.inject import ChaoticKube, FaultInjector, chaotic_client
+from poseidon_tpu.chaos.plan import FaultPlan, named_plan
+from poseidon_tpu.chaos.recorder import FlightRecorder
+
+log = logging.getLogger("poseidon.chaos.soak")
+
+# Pod request shapes: a narrow factor range so every round's pending set
+# falls into the same solver size bands (compile-shape stability is one
+# of the soak's gates, so the workload must not smuggle new compile keys
+# in mid-run).
+_POD_SHAPES = (
+    (200, 1 << 19), (400, 1 << 19), (400, 1 << 20), (800, 1 << 20),
+)
+_NODE_CPU = 32_000
+_NODE_RAM = 128 << 20
+
+
+def _spec(name: str, seed: int, machines: int, rounds: int,
+          pods_per_machine: int, churn: int, settle_rounds: int) -> dict:
+    return {
+        "name": name, "seed": seed, "machines": machines,
+        "rounds": rounds, "pods_per_machine": pods_per_machine,
+        "churn": churn, "settle_rounds": settle_rounds,
+    }
+
+
+def _pod_batches(spec: dict) -> List[List[dict]]:
+    """Per-round pod creation batches, a pure function of the spec.
+
+    Round 0 carries the initial population; every later round (settle
+    rounds included — churn does not stop while the system recovers)
+    adds ``churn`` pods.  A slice of each batch is owner-grouped to
+    exercise the job/owner-uid paths."""
+    rng = np.random.default_rng(spec["seed"])
+    total_rounds = spec["rounds"] + spec["settle_rounds"]
+    batches: List[List[dict]] = []
+    for r in range(total_rounds):
+        n = (
+            spec["machines"] * spec["pods_per_machine"] if r == 0
+            else spec["churn"]
+        )
+        batch = []
+        for i in range(n):
+            cpu, ram = _POD_SHAPES[int(rng.integers(len(_POD_SHAPES)))]
+            batch.append({
+                "name": f"soak-r{r}-{i}",
+                "cpu": cpu,
+                "ram": ram,
+                "owner": f"soak-job-r{r}-{i % 4}" if i % 3 == 0 else "",
+            })
+        batches.append(batch)
+    return batches
+
+
+def workload_events(spec: dict):
+    """Lower the soak workload onto the replay harness's ``TraceEvent``
+    vocabulary (machines at t=0, each round's batch as job_submits at
+    10 s round boundaries) — the planner-only offline view of the same
+    population."""
+    from poseidon_tpu.replay.trace import TraceEvent
+
+    events = [
+        TraceEvent(0.0, "machine_add", (i, _NODE_CPU, _NODE_RAM))
+        for i in range(spec["machines"])
+    ]
+    horizon = 10.0 * (spec["rounds"] + spec["settle_rounds"] + 1)
+    for r, batch in enumerate(_pod_batches(spec)):
+        by_shape: Dict[tuple, int] = {}
+        for pod in batch:
+            by_shape[(pod["cpu"], pod["ram"])] = (
+                by_shape.get((pod["cpu"], pod["ram"]), 0) + 1
+            )
+        for j, (shape, count) in enumerate(sorted(by_shape.items())):
+            events.append(TraceEvent(
+                r * 10.0, "job_submit",
+                (r * 100 + j, count, shape[0], shape[1], horizon),
+            ))
+    events.sort(key=lambda e: (e.time, e.kind))
+    return events
+
+
+def _placement_views(kube, poseidon, server):
+    """(kube_truth, scheduler_view): pod key -> node name on both sides,
+    joined through the glue id maps.  Entries only the scheduler knows
+    surface under a synthetic ``<uid:...>`` key so they diverge loudly
+    instead of vanishing from the comparison."""
+    from poseidon_tpu.graph.state import TaskState
+
+    inner = kube.inner if isinstance(kube, ChaoticKube) else kube
+    kube_truth = {
+        pod.key: pod.node_name
+        for pod in inner.pods.values()
+        if pod.phase == "Running" and pod.node_name
+    }
+    sched_view = {}
+    st = server.servicer.state
+    with st._lock:
+        running = {
+            uid: task.scheduled_to
+            for uid, task in st.tasks.items()
+            if task.state == TaskState.RUNNING and task.scheduled_to
+        }
+    for uid, machine_uuid in running.items():
+        pod = poseidon.shared.task_for_uid(uid)
+        node = poseidon.shared.node_for_resource(machine_uuid)
+        key = pod.key if pod is not None else f"<uid:{uid}>"
+        sched_view[key] = node if node is not None else f"<res:{machine_uuid}>"
+    return kube_truth, sched_view
+
+
+def _digest(view: Dict[str, str]) -> str:
+    return hashlib.sha256(
+        json.dumps(sorted(view.items())).encode()
+    ).hexdigest()[:16]
+
+
+def _metrics_dict(metrics) -> dict:
+    d = asdict(metrics)
+    if d.get("gap_bound") == float("inf"):
+        d["gap_bound"] = "inf"
+    return d
+
+
+def _await(cond: Callable[[], bool], timeout: float) -> bool:
+    """Poll ``cond`` until true or deadline.  The watchers' drain
+    barrier alone is racy against the watch->KeyedQueue pump (an event
+    still in the watch queue is invisible to ``drain_watchers``), so the
+    soak synchronizes on the EFFECT — ids resolving in the glue's shared
+    maps — before trusting a drain."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class SoakFailure(Exception):
+    def __init__(self, kind: str, detail: str, round_index: int) -> None:
+        super().__init__(f"{kind} (round {round_index}): {detail}")
+        self.kind = kind
+        self.detail = detail
+        self.round_index = round_index
+
+
+def run_soak(
+    machines: int = 200,
+    rounds: int = 10,
+    plan: str = "smoke",
+    seed: int = 0,
+    *,
+    pods_per_machine: int = 4,
+    churn: Optional[int] = None,
+    settle_rounds: int = 2,
+    out_dir: str = "out/soak",
+    until_round: Optional[int] = None,
+    expect_digests: Optional[Sequence[str]] = None,
+    on_round: Optional[Callable[[int, dict], None]] = None,
+) -> dict:
+    """Run one soak; returns the result artifact (never raises for soak
+    failures — they come back as ``ok=False`` plus a written flight
+    trace).
+
+    ``until_round``/``expect_digests`` are the re-drive interface
+    (replay/flight.py): stop after that many rounds and compare each
+    round's digest against the recorded one.  ``on_round(r, ctx)`` is a
+    test hook fired after the round is armed but before its workload
+    mutations; ``ctx`` exposes the live pieces (server, kube, poseidon,
+    injector) so a test can, e.g., kill the Firmament stub mid-soak.
+    """
+    from poseidon_tpu.check.ledger import fresh_compile_count
+    from poseidon_tpu.glue.fake_kube import FakeKube, Node, Pod
+    from poseidon_tpu.glue.poseidon import Poseidon
+    from poseidon_tpu.ops.transport import bucket_size
+    from poseidon_tpu.service.server import FirmamentTPUServer
+    from poseidon_tpu.utils.config import (
+        FirmamentTPUConfig,
+        PoseidonConfig,
+    )
+
+    churn = churn if churn is not None else max(machines // 20, 4)
+    spec = _spec(plan, seed, machines, rounds, pods_per_machine, churn,
+                 settle_rounds)
+    fault_plan: FaultPlan = named_plan(plan, rounds, seed)
+    injector = FaultInjector(fault_plan)
+    recorder = FlightRecorder(spec, fault_plan, out_dir=out_dir)
+    batches = _pod_batches(spec)
+    total_rounds = rounds + settle_rounds
+    if until_round is not None:
+        total_rounds = min(total_rounds, until_round)
+
+    result: dict = {
+        "ok": False, "plan": plan, "seed": seed, "machines": machines,
+        "rounds_requested": rounds, "rounds_run": 0,
+        "families_covered": list(fault_plan.families_covered()),
+        "digests": [], "warm_fresh_compiles": 0, "tiers": [],
+        "divergent_rounds": 0,
+    }
+    if expect_digests is not None:
+        result["digest_mismatches"] = []
+
+    # Precompile the solver ladder at the soak's scale before the first
+    # round, so round 0 pays every compile and the warm-round budget-0
+    # gate is unambiguous.
+    server_cfg = FirmamentTPUConfig(
+        precompile=True,
+        max_ecs=bucket_size(len(_POD_SHAPES) * 4, lo=8),
+        max_machines=0,
+    )
+    server = FirmamentTPUServer(
+        address="127.0.0.1:0", config=server_cfg
+    ).start()
+    kube = ChaoticKube(FakeKube(), injector)
+    client = chaotic_client(
+        server.address, injector,
+        rpc_timeout_s=10.0, rpc_retries=2, rpc_backoff_s=0.01,
+        rpc_backoff_max_s=0.05, retry_seed=seed,
+    )
+    cfg = PoseidonConfig(
+        firmament_address=server.address,
+        scheduling_interval=3600,
+        crash_loop_budget=4,
+        crash_backoff_s=0.01,
+        crash_backoff_max_s=0.05,
+    )
+    poseidon = Poseidon(
+        kube, config=cfg, firmament=client, run_loop=False
+    ).start(health_timeout=30)
+    server.servicer.planner.chaos = injector
+    ctx = {
+        "server": server, "kube": kube, "poseidon": poseidon,
+        "injector": injector,
+    }
+
+    def _round_faults(r: int) -> List[dict]:
+        return [e for e in injector.fired if e["round"] == r]
+
+    try:
+        for node_i in range(machines):
+            kube.add_node(Node(
+                name=f"m{node_i:04d}",
+                cpu_capacity=_NODE_CPU, ram_capacity=_NODE_RAM,
+            ))
+        # Barrier on the EFFECT, then the drain: every node must resolve
+        # in the shared map (events left the watch queue) and the queues
+        # must empty (the NodeAdded RPCs completed) before round 0 —
+        # otherwise the service-side precompile sees a partial fleet.
+        synced = _await(
+            lambda: all(
+                poseidon.shared.get_node(f"m{i:04d}") is not None
+                for i in range(machines)
+            ),
+            30.0,
+        )
+        if not (synced and poseidon.drain_watchers(timeout=30.0)):
+            raise SoakFailure("setup", "node sync never drained", 0)
+        # Precompile SYNCHRONOUSLY, after the fleet registered (the
+        # machine bucket derives from the live cluster) and before any
+        # round's ledger window opens.  Left to the lazy first-Schedule
+        # path, precompile keeps running in that handler thread after
+        # the client's RPC deadline expires, and its compile-completion
+        # events straggle into warm rounds' windows — a false budget-0
+        # violation under load.
+        server.servicer.ensure_precompiled()
+
+        for r in range(total_rounds):
+            injector.begin_round(r)
+            if on_round is not None:
+                on_round(r, ctx)
+            # Workload churn: this round's creations, plus completion +
+            # deletion of earlier cohorts (completions two rounds back,
+            # deletions of the completed cohort one round later) so the
+            # finished/removed lifecycle paths run under fault too.
+            for podspec in batches[r]:
+                kube.create_pod(Pod(
+                    name=podspec["name"], cpu_request=podspec["cpu"],
+                    ram_request=podspec["ram"],
+                    owner_uid=podspec["owner"],
+                ))
+            completed: List[str] = []
+            deleted: List[str] = []
+            if r >= 3:
+                inner = kube.inner
+                for podspec in batches[r - 2][:max(churn // 4, 1)]:
+                    key = f"default/{podspec['name']}"
+                    pod = inner.pods.get(key)
+                    if pod is not None and pod.phase == "Running":
+                        kube.set_pod_phase(key, "Succeeded")
+                        completed.append(key)
+                for podspec in batches[r - 3][:max(churn // 4, 1)]:
+                    key = f"default/{podspec['name']}"
+                    pod = inner.pods.get(key)
+                    if pod is not None and pod.phase == "Succeeded":
+                        kube.delete_pod("default", podspec["name"])
+                        deleted.append(key)
+            # Delivery barrier (skipped while the pod stream is chaos-
+            # held — those events land a round late by design): created
+            # pods must resolve to tasks, completed pods must finish
+            # (uid stops resolving), deleted pods must untrack; then the
+            # queue drain proves the RPCs behind them completed.
+            if not injector.is_stalled("pods"):
+                created = [f"default/{p['name']}" for p in batches[r]]
+                _await(
+                    lambda: all(
+                        poseidon.shared.uid_for_pod(k) is not None
+                        for k in created
+                    ) and all(
+                        poseidon.shared.uid_for_pod(k) is None
+                        for k in completed + deleted
+                    ),
+                    20.0,
+                )
+            poseidon.drain_watchers(timeout=30.0)
+
+            fresh0 = fresh_compile_count()
+            for _attempt in range(cfg.crash_loop_budget + 1):
+                delay = poseidon.try_round()
+                if delay is None:
+                    raise SoakFailure(
+                        "fatal", poseidon.fatal or "loop stopped", r
+                    )
+                if poseidon.loop_stats.consecutive_failures == 0:
+                    break
+                # Failed round: the soak compresses the backoff delay
+                # (the policy fired; sleeping it for real buys nothing).
+            fresh = fresh_compile_count() - fresh0
+            if r >= 1:
+                result["warm_fresh_compiles"] += fresh
+
+            # Quiesce before the divergence gate: release chaos-held
+            # event streams (their damage — a round solved on stale
+            # knowledge — is done) and let the watchers drain, so the
+            # comparison sees the reconciled state, not delivery lag.
+            # The gate itself then waits briefly for a match: delivery
+            # lag is transient and resolves under the wait, while a real
+            # divergence (a phantom placement, a missed rollback) is a
+            # fixed point no amount of waiting heals — THAT is what
+            # fails the soak.
+            injector.flush_events()
+            poseidon.drain_watchers(timeout=30.0)
+            kube_truth, sched_view = _placement_views(
+                kube, poseidon, server
+            )
+            if kube_truth != sched_view:
+                def _matches() -> bool:
+                    a, b = _placement_views(kube, poseidon, server)
+                    return a == b
+                _await(_matches, 10.0)
+                kube_truth, sched_view = _placement_views(
+                    kube, poseidon, server
+                )
+            metrics = server.servicer.planner.last_metrics
+            metrics_d = _metrics_dict(metrics)
+            # The soak-level ledger diff covers the WHOLE round attempt
+            # (retries, precompile, watcher work), not just the
+            # planner's own solve window — record both.
+            metrics_d["soak_fresh_compiles"] = fresh
+            result["tiers"].append(metrics.solve_tier)
+            digest = _digest(kube_truth)
+            result["digests"].append(digest)
+            result["rounds_run"] = r + 1
+            recorder.record_round(
+                r,
+                faults=_round_faults(r),
+                deltas=[
+                    {"type": int(d.type), "task": int(d.task_id),
+                     "resource": d.resource_id}
+                    for d in poseidon.last_deltas
+                ],
+                metrics=metrics_d,
+                digest=digest,
+                placements=len(kube_truth),
+            )
+            if kube_truth != sched_view:
+                only_kube = sorted(
+                    set(kube_truth.items()) - set(sched_view.items())
+                )[:5]
+                only_sched = sorted(
+                    set(sched_view.items()) - set(kube_truth.items())
+                )[:5]
+                result["divergent_rounds"] += 1
+                raise SoakFailure(
+                    "divergence",
+                    f"kube-only={only_kube} scheduler-only={only_sched}",
+                    r,
+                )
+            if expect_digests is not None and r < len(expect_digests) \
+                    and digest != expect_digests[r]:
+                result["digest_mismatches"].append(
+                    {"round": r, "expected": expect_digests[r],
+                     "got": digest}
+                )
+
+        if until_round is None:
+            pending = sorted(
+                pod.key for pod in kube.inner.pods.values()
+                if pod.phase == "Pending"
+            )
+            if pending:
+                raise SoakFailure(
+                    "unplaced",
+                    f"{len(pending)} pods still Pending after settle: "
+                    f"{pending[:5]}",
+                    total_rounds,
+                )
+            if result["warm_fresh_compiles"]:
+                raise SoakFailure(
+                    "fresh-compiles",
+                    f"{result['warm_fresh_compiles']} fresh XLA compiles "
+                    "in warm rounds (budget 0)",
+                    total_rounds,
+                )
+        result["ok"] = True
+        if expect_digests is not None:
+            result["reproduced"] = not result["digest_mismatches"]
+            result["ok"] = result["ok"] and result["reproduced"]
+    except SoakFailure as e:
+        result["failure"] = {"kind": e.kind, "detail": e.detail,
+                             "round": e.round_index}
+        result["trace_path"] = recorder.record_failure(
+            e.round_index, e.kind, e.detail
+        )
+        result["failing_round"] = e.round_index
+        log.error("soak failed (%s); flight trace: %s",
+                  e, result["trace_path"])
+    finally:
+        poseidon.stop()
+        try:
+            server.stop(grace=0.2)
+        except Exception:  # noqa: BLE001 - a killed-mid-soak server is fine
+            pass
+        client.close()
+
+    result["fired"] = list(injector.fired)
+    result["resyncs"] = (
+        poseidon.pod_watcher.resyncs + poseidon.node_watcher.resyncs
+    )
+    stats = poseidon.loop_stats
+    result["loop_stats"] = {
+        "rounds": stats.rounds, "placed": stats.placed,
+        "preempted": stats.preempted, "migrated": stats.migrated,
+        "failed_rounds": stats.failed_rounds,
+        "bind_failures": stats.bind_failures,
+        "requeued": stats.requeued,
+    }
+    return result

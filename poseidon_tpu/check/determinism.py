@@ -143,8 +143,13 @@ def _collect_set_vars(fn: ast.AST) -> Set[str]:
 
 class DeterminismRule(Rule):
     name = "determinism"
+    # chaos/ is in scope because fault plans MUST be seed-reproducible:
+    # a soak whose faults fire off the wall clock or an OS-entropy RNG
+    # cannot be re-driven from its flight trace, which voids the whole
+    # subsystem's replayability contract (docs/CHAOS.md).
     scopes = (
         "poseidon_tpu/replay/", "poseidon_tpu/graph/", "poseidon_tpu/ops/",
+        "poseidon_tpu/chaos/",
     )
 
     def check(self, tree: ast.AST, source: str, path: str) -> List[Finding]:

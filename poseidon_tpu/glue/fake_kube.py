@@ -79,6 +79,17 @@ class KubeAPI:
     def watch_nodes(self) -> "queue.Queue[Event]":
         raise NotImplementedError
 
+    def unwatch_pods(self, watch) -> None:
+        """Unsubscribe a watch returned by ``watch_pods`` (the watcher's
+        resync path drops the dead stream before re-subscribing, or the
+        fan-out keeps feeding an abandoned queue forever).  Default
+        no-op: adapters whose watch streams die with their server-side
+        connection have nothing to release."""
+
+    def unwatch_nodes(self, watch) -> None:
+        """Unsubscribe a watch returned by ``watch_nodes`` (see
+        ``unwatch_pods``)."""
+
     def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
         raise NotImplementedError
 
@@ -163,6 +174,16 @@ class FakeKube(KubeAPI):
         with self._lock:
             self._node_watchers.append(q)
         return q
+
+    def unwatch_pods(self, watch) -> None:
+        with self._lock:
+            if watch in self._pod_watchers:
+                self._pod_watchers.remove(watch)
+
+    def unwatch_nodes(self, watch) -> None:
+        with self._lock:
+            if watch in self._node_watchers:
+                self._node_watchers.remove(watch)
 
     def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
         with self._lock:

@@ -14,6 +14,7 @@ Re-creates the reference's node watcher (pkg/k8sclient/nodewatcher.go):
 
 from __future__ import annotations
 
+import copy
 import logging
 import threading
 from typing import List
@@ -75,6 +76,8 @@ class NodeWatcher:
         self.queue = KeyedQueue()
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
+        # Observability: how many times the watch dropped and re-synced.
+        self.resyncs = 0
 
     def run(self) -> None:
         watch = self.kube.watch_nodes()
@@ -102,7 +105,35 @@ class NodeWatcher:
                 kind, node = watch.get(timeout=0.2)
             except Exception:
                 continue
+            if kind == "ERROR":
+                # Same contract as the pod watcher: a dropped watch
+                # swallowed events; re-subscribe, re-list, synthesize
+                # the deletions the gap hid.
+                log.warning("node watch dropped (%s); resyncing", node)
+                watch = self._resync(watch)
+                continue
             self.queue.add(node.name, (kind, node))
+
+    def _resync(self, old_watch=None):
+        """Re-list + re-watch after a dropped node watch; nodes the
+        tracked world knows but the fresh list lacks were removed while
+        disconnected — synthesize their DELETED events so the scheduler
+        evicts their tasks.  (Replaying known nodes as ADDED is sound
+        here: the node phase machine diffs capacity/labels/health
+        regardless of event kind.)"""
+        self.resyncs += 1
+        if old_watch is not None:
+            self.kube.unwatch_nodes(old_watch)
+        watch = self.kube.watch_nodes()
+        listed = {n.name: n for n in self.kube.list_nodes()}
+        known = self.shared.nodes_snapshot()
+        for name in sorted(set(known) - set(listed)):
+            lost = copy.copy(known[name])
+            lost.deleted = True
+            self.queue.add(name, ("DELETED", lost))
+        for name in sorted(listed):
+            self.queue.add(name, ("ADDED", listed[name]))
+        return watch
 
     def _worker(self) -> None:
         while True:

@@ -15,6 +15,7 @@ Re-creates the reference's pod watcher semantics (pkg/k8sclient/podwatcher.go):
 
 from __future__ import annotations
 
+import copy
 import logging
 import threading
 from dataclasses import dataclass, field
@@ -65,6 +66,8 @@ class PodWatcher:
         self._jobs_lock = threading.Lock()
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
+        # Observability: how many times the watch dropped and re-synced.
+        self.resyncs = 0
 
     # ------------------------------------------------------------- job model
 
@@ -169,7 +172,43 @@ class PodWatcher:
                 kind, pod = watch.get(timeout=0.2)
             except Exception:
                 continue
+            if kind == "ERROR":
+                # Watch dropped (stale resourceVersion / connection
+                # loss): events between the drop and now are GONE, so a
+                # fresh watch alone would leave the scheduler's world
+                # diverged forever.  Resync: re-subscribe, re-list, and
+                # synthesize the deletions the dead watch swallowed.
+                log.warning("pod watch dropped (%s); resyncing", pod)
+                watch = self._resync(watch)
+                continue
             self._enqueue(kind, pod)
+
+    def _resync(self, old_watch=None):
+        """Re-list + re-watch after a dropped watch (the informer
+        relist path).  Subscribe-then-list ordering leaves no gap: an
+        event racing the list is delivered by the new watch too, and the
+        phase machine is idempotent under the duplicate.  Pods the
+        tracked world knows but the fresh list lacks were deleted while
+        disconnected — synthesize their DELETED events; pods it knows
+        that still exist replay as MODIFIED, so a spec change whose
+        event died with the watch still lands (the ADDED path ignores
+        already-known pods)."""
+        self.resyncs += 1
+        if old_watch is not None:
+            self.kube.unwatch_pods(old_watch)
+        watch = self.kube.watch_pods()
+        listed = {}
+        for pod in self.kube.list_pods():
+            listed[pod.key] = pod
+        known = self.shared.pods_snapshot()
+        for key in sorted(set(known) - set(listed)):
+            lost = copy.copy(known[key])
+            lost.deleted = True
+            self._enqueue("DELETED", lost)
+        for key in sorted(listed):
+            kind = "MODIFIED" if key in known else "ADDED"
+            self._enqueue(kind, listed[key])
+        return watch
 
     def _enqueue(self, kind: str, pod: Pod) -> None:
         if pod.scheduler_name != self.scheduler_name:

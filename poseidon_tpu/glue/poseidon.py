@@ -15,10 +15,13 @@ delta are fatal in the reference (poseidon.go:43); here they raise.
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 from dataclasses import dataclass
 from typing import List, Optional
+
+import grpc
 
 from poseidon_tpu.glue.fake_kube import KubeAPI
 from poseidon_tpu.glue.nodewatcher import NodeWatcher
@@ -26,7 +29,7 @@ from poseidon_tpu.glue.podwatcher import PodWatcher
 from poseidon_tpu.glue.stats_server import StatsServer
 from poseidon_tpu.glue.types import SharedState
 from poseidon_tpu.protos import firmament_pb2 as fpb
-from poseidon_tpu.service.client import FirmamentClient
+from poseidon_tpu.service.client import FirmamentClient, rpc_code
 from poseidon_tpu.utils.config import PoseidonConfig
 
 log = logging.getLogger("poseidon")
@@ -38,6 +41,16 @@ class LoopStats:
     placed: int = 0
     preempted: int = 0
     migrated: int = 0
+    # Hardening counters (the chaos soak's observability surface):
+    # rounds that raised, the running consecutive-failure count feeding
+    # the crash-loop budget, PLACE enactments the API server rejected
+    # (each rolled back + requeued), and tasks requeued — by the bind
+    # rollback or by the suspect reconciler after a commit-ambiguous
+    # Schedule failure.
+    failed_rounds: int = 0
+    consecutive_failures: int = 0
+    bind_failures: int = 0
+    requeued: int = 0
 
 
 class Poseidon:
@@ -57,7 +70,12 @@ class Poseidon:
         self.run_loop = run_loop
         self.config = config or PoseidonConfig()
         self.kube = kube
-        self.fc = firmament or FirmamentClient(self.config.firmament_address)
+        self.fc = firmament or FirmamentClient(
+            self.config.firmament_address,
+            rpc_timeout_s=self.config.rpc_timeout_s,
+            rpc_retries=self.config.rpc_retries,
+            rpc_backoff_s=self.config.rpc_backoff_s,
+        )
         self.shared = SharedState()
         # Watchers own a second client connection in the reference
         # (k8sclient.go:74); one python client object is thread-safe here.
@@ -74,6 +92,22 @@ class Poseidon:
         self.loop_stats = LoopStats()
         self._stop = threading.Event()
         self._loop_thread: Optional[threading.Thread] = None
+        # Crash-loop hardening state: the fatal-stop reason once the
+        # budget is exhausted (None while healthy), seeded jitter for the
+        # failure backoff (seeded: chaos soaks re-run bit-for-bit).
+        self.fatal: Optional[str] = None
+        self._backoff_jitter = random.Random(0)
+        # Suspect-reconciler state: glue's own record of enacted
+        # placements (uid -> node), and whether the last Schedule()
+        # attempt failed in flight — the commit-ambiguous window in
+        # which the service may hold placements whose deltas were lost.
+        self._enacted: dict = {}
+        self._schedule_suspect = False
+        # Half-completed rollbacks: uid -> (td, jd) whose task_removed
+        # landed but whose resubmit RPC failed (replayed every round).
+        self._resubmit_pending: dict = {}
+        # Last successful round's deltas (the flight recorder's view).
+        self.last_deltas: List[fpb.SchedulingDelta] = []
 
     # --------------------------------------------------------------- lifecycle
 
@@ -118,16 +152,120 @@ class Poseidon:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            try:
-                self.schedule_once()
-            except Exception:
-                log.exception("schedule round failed")
-            self._stop.wait(self.config.scheduling_interval)
+            delay = self.try_round()
+            if delay is None:
+                return  # crash-loop budget exhausted; try_round stopped us
+            self._stop.wait(delay)
+
+    def try_round(self) -> Optional[float]:
+        """One loop iteration's round + failure policy.
+
+        Returns the delay before the next round: the scheduling interval
+        after a healthy round, an exponentially-backed-off (jittered)
+        retry delay after a failed one, or ``None`` once the crash-loop
+        budget is exhausted — the loop then stops FATALLY with
+        ``self.fatal`` set, instead of the old unbounded
+        log-and-spin-on-the-interval swallow.  Factored out of ``_loop``
+        so the soak harness drives the exact production failure policy
+        without a thread."""
+        try:
+            self.schedule_once()
+        except Exception:
+            self.loop_stats.failed_rounds += 1
+            self.loop_stats.consecutive_failures += 1
+            n = self.loop_stats.consecutive_failures
+            log.exception(
+                "schedule round failed (consecutive failure %d/%d)",
+                n, self.config.crash_loop_budget,
+            )
+            if n >= self.config.crash_loop_budget:
+                self.fatal = (
+                    f"schedule loop stopping: {n} consecutive round "
+                    f"failures exhausted the crash-loop budget "
+                    f"({self.config.crash_loop_budget})"
+                )
+                log.error("%s", self.fatal)
+                self._stop.set()
+                return None
+            backoff = min(
+                self.config.crash_backoff_s * (2 ** (n - 1)),
+                self.config.crash_backoff_max_s,
+            )
+            # Full jitter on [backoff/2, backoff].
+            return backoff * (0.5 + 0.5 * self._backoff_jitter.random())
+        self.loop_stats.consecutive_failures = 0
+        return self.config.scheduling_interval
 
     def schedule_once(self) -> List[fpb.SchedulingDelta]:
-        """One Schedule() call + delta enactment (poseidon.go:32-67)."""
-        deltas = self.fc.schedule()
+        """One Schedule() call + transactional delta enactment
+        (poseidon.go:32-67).
+
+        Enactment is per-delta transactional: a PLACE whose bind the API
+        server rejects is ROLLED BACK on the scheduler (task_removed +
+        task_submitted requeues the pod as runnable, freeing the
+        reservation) instead of leaving the scheduler's view diverged
+        from the kube truth, and the remaining deltas still enact.
+        Unknown ids stay fatal (poseidon.go:43) — they mean the id maps
+        themselves are broken, which no retry fixes."""
+        self.last_deltas = []
+        self._flush_resubmits()
+        try:
+            deltas = self.fc.schedule()
+        except Exception as e:
+            # Commit-ambiguity is code-aware: UNAVAILABLE means the
+            # request was never processed (and the client already
+            # retries it), so nothing committed; every other failure —
+            # DEADLINE after commit, a codeless channel error, a
+            # non-RPC exception — may have run the round and lost the
+            # reply.  Mark the window; the next fully-enacted round
+            # reconciles (see below).
+            if rpc_code(e) != grpc.StatusCode.UNAVAILABLE:
+                self._schedule_suspect = True
+            raise
+        # Recorded before enactment so a round that fails mid-enactment
+        # still attributes THESE deltas (not a previous round's) to
+        # itself in the flight trace.
+        self.last_deltas = list(deltas)
+        if getattr(self.fc, "schedule_retried", False):
+            # The client absorbed an UNAVAILABLE with a retry.  On a
+            # real network that code can surface AFTER the service
+            # processed the request (reply lost mid-stream), making the
+            # retry's reply the diff against an already-committed round
+            # — so a retried schedule is commit-ambiguous too.  The
+            # sweep is cheap next to a permanent phantom divergence.
+            self._schedule_suspect = True
+        suspect = self._schedule_suspect
+        delta_uids = set()
+        try:
+            self._enact(deltas, delta_uids)
+        except Exception:
+            # A mid-enactment abort orphans this round's remaining
+            # committed deltas — the same phantom shape as a lost
+            # reply.  Arm the reconciler; the next fully-enacted round
+            # requeues whatever never got bound.
+            self._schedule_suspect = True
+            raise
+        if suspect:
+            self._reconcile_after_failure(delta_uids)
+        # Lifecycle GC: placements whose tasks finished or left the
+        # cluster (the pod watcher owns those transitions) must leave
+        # the enacted map, or it grows one entry per pod ever placed.
+        live = self.shared.live_uids()
+        self._enacted = {
+            uid: node for uid, node in self._enacted.items() if uid in live
+        }
+        # Cleared only here, after enactment AND reconcile completed: a
+        # round that raises mid-way keeps the flag, so the pending
+        # reconcile is retried instead of silently dropped.
+        self._schedule_suspect = False
+        self.loop_stats.rounds += 1
+        return list(deltas)
+
+    def _enact(self, deltas, delta_uids: set) -> None:
+        """Apply one round's deltas to the cluster (transactional per
+        delta; see ``schedule_once``)."""
         for delta in deltas:
+            delta_uids.add(delta.task_id)
             if delta.type == fpb.SchedulingDelta.PLACE:
                 pod = self.shared.task_for_uid(delta.task_id)
                 node = self.shared.node_for_resource(delta.resource_id)
@@ -135,7 +273,17 @@ class Poseidon:
                     raise RuntimeError(
                         f"PLACE delta references unknown ids: {delta}"
                     )
-                self.kube.bind_pod(pod.namespace, pod.name, node)
+                try:
+                    self.kube.bind_pod(pod.namespace, pod.name, node)
+                except Exception as e:  # noqa: BLE001 - per-delta rollback
+                    log.warning(
+                        "PLACE %s -> %s failed (%s); rolling back and "
+                        "requeueing", pod.key, node, e,
+                    )
+                    self.loop_stats.bind_failures += 1
+                    self._requeue_task(delta.task_id)
+                    continue
+                self._enacted[delta.task_id] = node
                 self.loop_stats.placed += 1
             elif delta.type in (
                 fpb.SchedulingDelta.PREEMPT,
@@ -146,14 +294,100 @@ class Poseidon:
                     raise RuntimeError(
                         f"PREEMPT/MIGRATE delta references unknown task: {delta}"
                     )
-                self.kube.delete_pod(pod.namespace, pod.name)
+                try:
+                    self.kube.delete_pod(pod.namespace, pod.name)
+                except KeyError:
+                    # Already gone (deleted out from under us): the
+                    # watcher's DELETED event hands TaskRemoved to the
+                    # scheduler; the enactment's intent already holds.
+                    log.warning(
+                        "PREEMPT/MIGRATE delete of %s: pod already gone",
+                        pod.key,
+                    )
+                self._enacted.pop(delta.task_id, None)
                 if delta.type == fpb.SchedulingDelta.PREEMPT:
                     self.loop_stats.preempted += 1
                 else:
                     self.loop_stats.migrated += 1
             # NOOP: skip (poseidon.go:64).
-        self.loop_stats.rounds += 1
-        return list(deltas)
+
+    # ------------------------------------------------- divergence containment
+
+    def _requeue_task(self, uid: int) -> None:
+        """Roll one placement back on the scheduler: remove + resubmit
+        re-enters the task RUNNABLE with its reservation freed, so the
+        scheduler's view returns to the kube truth (pod Pending) and the
+        next round re-places it.  Uses only the existing RPC vocabulary —
+        the state machine answers TASK_SUBMITTED_OK because the removal
+        landed first."""
+        entry = self.shared.get_task(uid)
+        if entry is None:
+            return
+        td = fpb.TaskDescriptor()
+        td.CopyFrom(entry.descriptor)
+        td.scheduled_to_resource = ""  # requeue as unbound
+        jd = fpb.JobDescriptor(
+            uuid=td.job_id, name=entry.pod.owner_uid or entry.pod.key
+        )
+        self.fc.task_removed(uid)
+        self._enacted.pop(uid, None)
+        try:
+            self.fc.task_submitted(td, jd)
+        except Exception:
+            # Half rolled back: removed server-side, resubmit lost.
+            # Left alone the task would exist NOWHERE and the pod would
+            # pend forever — park the descriptor; _flush_resubmits
+            # replays it at the top of every round until it lands.  The
+            # raise fails this round, so the crash-loop budget governs
+            # the retry cadence.
+            self._resubmit_pending[uid] = (td, jd)
+            raise
+        self.loop_stats.requeued += 1
+
+    def _flush_resubmits(self) -> None:
+        """Finish half-completed rollbacks (see ``_requeue_task``):
+        replay parked resubmits until each lands or its pod left the
+        cluster.  TASK_SUBMITTED_OK / ALREADY_SUBMITTED are both
+        tolerated replies, so a replay that raced a watcher resubmit is
+        harmless."""
+        for uid, (td, jd) in sorted(self._resubmit_pending.items()):
+            if self.shared.get_task(uid) is None:
+                del self._resubmit_pending[uid]  # pod left the cluster
+                continue
+            self.fc.task_submitted(td, jd)
+            del self._resubmit_pending[uid]
+            self.loop_stats.requeued += 1
+
+    def _reconcile_after_failure(self, delta_uids) -> None:
+        """Heal the commit-ambiguity window after a failed Schedule()
+        call (the suspect flag): if that call's round committed on the
+        service but its reply was lost, the service holds placements
+        whose PLACE deltas no one enacted — the pods sit Pending in kube
+        forever while the scheduler believes them running.
+
+        Candidates: tracked, non-finished tasks that (a) glue never
+        enacted a placement for, (b) got no delta in THIS round either,
+        and (c) did not arrive already-bound (the glue-restart adoption
+        path).  Requeueing them (remove + resubmit) is idempotent kube-
+        truth re-assertion: a phantom placement is freed and re-placed
+        next round; a genuinely pending pod just re-enters the queue.
+        Runs only in rounds following a commit-ambiguous Schedule
+        failure, until one fully enacts (the suspect flag survives a
+        round that raises mid-enactment) — never in steady state, so
+        the wait-fairness escalator is undisturbed."""
+        healed = 0
+        for uid, pod in sorted(self.shared.live_uids().items()):
+            if uid in delta_uids or uid in self._enacted:
+                continue
+            if pod.node_name:
+                continue  # adopted pre-bound on restart; not ours to touch
+            self._requeue_task(uid)
+            healed += 1
+        if healed:
+            log.warning(
+                "post-failure reconcile requeued %d possibly-phantom "
+                "placements", healed,
+            )
 
     # -------------------------------------------------------------- test hooks
 

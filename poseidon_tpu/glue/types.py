@@ -83,6 +83,26 @@ class SharedState:
             entry = self._tasks.get(uid)
             return entry.pod if entry else None
 
+    def pods_snapshot(self) -> Dict[str, Pod]:
+        """pod key -> last-seen Pod for every tracked task (the watcher
+        resync path diffs this against a fresh list to synthesize the
+        DELETED events a dropped watch swallowed)."""
+        with self._lock:
+            return {
+                entry.pod.key: entry.pod for entry in self._tasks.values()
+            }
+
+    def live_uids(self) -> Dict[int, Pod]:
+        """uid -> Pod for every non-finished tracked task (the
+        suspect-reconciler's candidate set after a commit-ambiguous
+        Schedule failure)."""
+        with self._lock:
+            return {
+                uid: entry.pod
+                for uid, entry in self._tasks.items()
+                if not entry.finished
+            }
+
     # ------------------------------------------------------------------ nodes
 
     def put_node(
@@ -115,6 +135,14 @@ class SharedState:
     def node_for_resource(self, uuid: str) -> Optional[str]:
         with self._lock:
             return self._res_to_node.get(uuid)
+
+    def nodes_snapshot(self) -> Dict[str, Node]:
+        """node name -> last-seen Node for every tracked node (the node
+        watcher's resync diff, mirroring ``pods_snapshot``)."""
+        with self._lock:
+            return {
+                name: entry.node for name, entry in self._nodes.items()
+            }
 
     def resource_for_node(self, name: str) -> Optional[str]:
         with self._lock:

@@ -99,6 +99,12 @@ class RoundMetrics:
     pruned_width: int = 0
     pruned_price_out_rounds: int = 0
     pruned_escalations: int = 0
+    # Which tier of the degraded-mode ladder served the round (worst
+    # band wins): "pruned" (shortlist + full-plane certificate),
+    # "dense" (full-plane solve), "host_greedy" (the last-resort
+    # deterministic host fallback — feasible, atomicity-preserving,
+    # UNCERTIFIED), or "quiet"/"none" for skipped/degenerate rounds.
+    solve_tier: str = "none"
     # False when any band's solve exhausted its iteration budget even on a
     # cold retry (gap_bound is then inf and the committed placement is the
     # repaired feasible-but-suboptimal one).  Alarmed via log.error.
@@ -378,6 +384,15 @@ class RoundPlanner:
         self._pruned_width = 0
         self._pruned_rounds = 0
         self._pruned_escalations = 0
+        # Worst degraded-mode tier used this round (index into _TIERS).
+        self._tier_rank = -1
+        # Chaos seam (poseidon_tpu/chaos): when set, an object whose
+        # ``solver_fault() -> (force_uncertified, partial_fraction)`` is
+        # consulted per band — forcing the degraded host-greedy tier
+        # (certificate-failure injection) and/or capping the fraction of
+        # supply placed (partial-Schedule-response injection).  None in
+        # production; the solve path itself is unchanged when unset.
+        self.chaos = None
 
     # ------------------------------------------------------------- warm frames
 
@@ -630,6 +645,7 @@ class RoundPlanner:
             # a quiet round after a non-converged one is still uncertified.
             metrics.gap_bound = m.gap_bound
             metrics.converged = m.converged
+            metrics.solve_tier = "quiet"
             st.round_index += 1
             metrics.total_seconds = time.perf_counter() - t0
             self.last_metrics = metrics
@@ -1018,6 +1034,7 @@ class RoundPlanner:
         self._pruned_width = 0
         self._pruned_rounds = 0
         self._pruned_escalations = 0
+        self._tier_rank = -1
         remaining = sorted(set(bands.tolist()))
         if len(remaining) > 1:
             chained = self._try_chained_wave(
@@ -1075,6 +1092,8 @@ class RoundPlanner:
         metrics.pruned_width = self._pruned_width
         metrics.pruned_price_out_rounds = self._pruned_rounds
         metrics.pruned_escalations = self._pruned_escalations
+        if self._tier_rank >= 0:
+            metrics.solve_tier = self._TIERS[self._tier_rank]
         return flows_full
 
     def _try_chained_wave(self, ecs, mt, bands, remaining, committed_cpu,
@@ -1213,6 +1232,7 @@ class RoundPlanner:
         metrics.gap_bound = max(sol1.gap_bound, sol2.gap_bound)
         metrics.iterations = sol1.iterations + sol2.iterations
         metrics.bf_sweeps = sol1.bf_sweeps + sol2.bf_sweeps
+        metrics.solve_tier = "dense"  # the chained wave is a full-plane solve
         if self.incremental:
             for key_band, ecs_b, sol, costs_b, unsched_b in (
                 (int(remaining[0]), ecs_1, sol1, cm1.costs,
@@ -1233,6 +1253,64 @@ class RoundPlanner:
             on_band(idx2, True, flows_full)
         return flows_full
 
+    # The degraded-mode ladder, best tier first.  _note_tier records the
+    # WORST tier any band of the round used.
+    _TIERS = ("pruned", "dense", "host_greedy")
+
+    def _note_tier(self, tier: str) -> None:
+        self._tier_rank = max(self._tier_rank, self._TIERS.index(tier))
+
+    def _solve_host_greedy(self, ecs_b, cm, col_cap, partial_fraction=None):
+        """The last rung of the degraded ladder: a deterministic,
+        host-only feasible placement (cheapest-arc greedy) used when
+        neither the pruned nor the dense solve can certify — injected
+        certificate failure, or a budget-exhausted cold solve.  Feasible
+        by construction (column/arc caps respected), gang-atomic
+        (partially-covered gang rows are dropped whole), and UNCERTIFIED:
+        ``gap_bound`` is inf, so the round reports ``converged=False``
+        and no warm frame is saved.  ``partial_fraction`` caps the total
+        units placed (the partial-Schedule-response fault: the service
+        answers with a deliberately incomplete round)."""
+        from poseidon_tpu.ops.transport import TransportSolution, greedy_flows
+
+        E, M = cm.costs.shape
+        flows = greedy_flows(
+            cm.costs, ecs_b.supply, col_cap, cm.arc_capacity
+        )
+        if partial_fraction is not None:
+            budget = int(int(ecs_b.supply.sum()) * partial_fraction)
+            for e in range(E):
+                row_units = int(flows[e].sum())
+                if row_units <= budget:
+                    budget -= row_units
+                    continue
+                # Trim this row to the remaining budget, columns in
+                # ascending order, then zero every later row.
+                keep = budget
+                for m in range(M):
+                    take = min(int(flows[e, m]), keep)
+                    flows[e, m] = take
+                    keep -= take
+                budget = 0
+        if ecs_b.is_gang is not None and ecs_b.is_gang.any():
+            placed = flows.sum(axis=1)
+            partial = (
+                ecs_b.is_gang & (placed > 0) & (placed < ecs_b.supply)
+            )
+            flows[partial] = 0
+        unsched = (ecs_b.supply - flows.sum(axis=1)).astype(np.int32)
+        finite = np.where(cm.costs >= INF_COST, 0, cm.costs).astype(np.int64)
+        objective = int(
+            (flows.astype(np.int64) * finite).sum()
+            + (unsched.astype(np.int64)
+               * cm.unsched_cost.astype(np.int64)).sum()
+        )
+        return TransportSolution(
+            flows=flows.astype(np.int32), unsched=unsched,
+            prices=np.zeros(E + M + 1, dtype=np.int32),
+            objective=objective, gap_bound=float("inf"), iterations=0,
+        )
+
     def _solve_band(self, band, ecs_b, cm, col_cap, machine_uuids):
         """One band's solve: warm-started (per-band frames are stable
         across rounds because the band of an EC is a function of its
@@ -1242,9 +1320,21 @@ class RoundPlanner:
         full plane, or (when the shortlist gate fires: dense, wide,
         row-heavy bands) on the pruned plane with a full-plane price-out
         certificate (``_try_pruned_band``), with the dense path as the
-        universal escalation fallback.  Warm frames are always saved in
-        FULL-plane coordinates, so carried prices survive the pruned
-        path's column remap round to round."""
+        universal escalation fallback and the deterministic host-greedy
+        placement as the last resort when certification fails outright
+        (``RoundMetrics.solve_tier`` records which rung served).  Warm
+        frames are always saved in FULL-plane coordinates, so carried
+        prices survive the pruned path's column remap round to round."""
+        if self.chaos is not None:
+            forced, frac = self.chaos.solver_fault()
+            if forced or frac is not None:
+                # Injected certificate failure / partial round: the
+                # degraded tier serves, exactly as it would after a real
+                # double escalation.
+                sol = self._solve_host_greedy(ecs_b, cm, col_cap, frac)
+                self._note_tier("host_greedy")
+                self._warm_bands.pop(band, None)
+                return sol
         eps_start = None
         prices = flows0 = unsched0 = None
         if self.incremental:
@@ -1273,12 +1363,27 @@ class RoundPlanner:
         warm_state = (prices, flows0, unsched0, eps_start)
 
         out = self._try_pruned_band(ecs_b, cm, col_cap, warm_state)
+        tier = "pruned"
         if out is None:
             out = self._solve_plane(
                 ecs_b, cm.costs, col_cap, cm.arc_capacity,
                 cm.unsched_cost, warm_state,
             )
+            tier = "dense"
         sol, effective_costs = out
+        if sol.gap_bound == float("inf"):
+            # Even the dense cold retry exhausted its budget: take the
+            # degraded tier's deterministic host placement instead of
+            # committing whatever repaired-feasible state the aborted
+            # device ladder left behind.  Still uncertified (gap stays
+            # inf -> converged=False + alarm), but reproducible and
+            # gang-atomic; the aborted solve's work stays visible via
+            # the hidden counters.
+            self._hidden_iters += sol.iterations
+            self._hidden_bf += sol.bf_sweeps
+            sol = self._solve_host_greedy(ecs_b, cm, col_cap)
+            tier = "host_greedy"
+        self._note_tier(tier)
 
         if sol.gap_bound != float("inf"):
             self._warm_bands[band] = _WarmState(

@@ -13,10 +13,18 @@ placement quality.
 
 from poseidon_tpu.replay.trace import TraceEvent, synthesize_trace
 from poseidon_tpu.replay.driver import ReplayDriver, ReplayReport
+from poseidon_tpu.replay.flight import (
+    flight_trace_events,
+    load_flight,
+    redrive_flight,
+)
 
 __all__ = [
     "TraceEvent",
     "synthesize_trace",
     "ReplayDriver",
     "ReplayReport",
+    "flight_trace_events",
+    "load_flight",
+    "redrive_flight",
 ]

@@ -1,0 +1,70 @@
+"""Flight-trace loading + offline re-drive.
+
+A soak failure (poseidon_tpu/chaos) leaves a ``FlightTrace`` JSON under
+``out/soak/``.  This module is the replay-side consumer:
+
+- ``load_flight(path)`` parses the trace;
+- ``redrive_flight(path)`` reconstructs the SAME soak — seeded workload,
+  same fault plan — and re-drives it round by round up to the recorded
+  failing round, checking each round's placement digest against the
+  recorded one.  A clean re-drive (``reproduced=True``) means the
+  failure's entire input state is on disk and the failing round can be
+  studied offline at will;
+- ``flight_trace_events(path)`` lowers the workload onto the replay
+  harness's ``TraceEvent`` vocabulary for planner-only analysis
+  (``ReplayDriver`` accepts the result directly — no glue stack, no
+  faults, just the population).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from poseidon_tpu.replay.trace import TraceEvent
+
+
+def load_flight(path: str):
+    """Parse a flight trace written by the chaos recorder."""
+    from poseidon_tpu.chaos.recorder import FlightTrace
+
+    return FlightTrace.load(path)
+
+
+def flight_trace_events(path: str) -> List[TraceEvent]:
+    """The trace's workload as replay TraceEvents."""
+    return load_flight(path).to_trace_events()
+
+
+def redrive_flight(path: str) -> dict:
+    """Re-drive a recorded soak to its failing round.
+
+    Returns the re-drive's soak result plus ``reproduced``: True when
+    every re-driven round's placement digest matches the recording —
+    i.e. the trace deterministically reconstructs the exact pre-failure
+    state.  The failure itself (a killed service, a divergence) is an
+    environmental event the re-drive does NOT repeat; what it proves is
+    that the recorded inputs land you on the identical failing round."""
+    from poseidon_tpu.chaos.soak import run_soak
+
+    trace = load_flight(path)
+    spec = trace.spec
+    failure = trace.failure or {}
+    failing_round = int(failure.get("round", len(trace.rounds)))
+    expect = [r["digest"] for r in trace.rounds]
+    result = run_soak(
+        machines=int(spec["machines"]),
+        rounds=int(spec["rounds"]),
+        plan=str(spec["name"]),
+        seed=int(spec["seed"]),
+        pods_per_machine=int(spec["pods_per_machine"]),
+        churn=int(spec["churn"]),
+        settle_rounds=int(spec["settle_rounds"]),
+        until_round=failing_round,
+        expect_digests=expect,
+    )
+    result["failing_round"] = failing_round
+    result["reproduced"] = (
+        result.get("reproduced", False)
+        and result["rounds_run"] == failing_round
+    )
+    return result

@@ -5,12 +5,27 @@ one method per RPC, and *fatal* treatment of reply enums the client never
 expects in a healthy system (NOT_FOUND on lifecycle RPCs, etc.) — here a
 raised ``FatalReplyError`` instead of ``glog.Fatalf`` so callers decide
 whether to die (the glue process does, matching the reference's posture).
+
+On top of the reference's semantics, every RPC carries a deadline and a
+bounded retry with exponential backoff + jitter (the reference has
+neither: a wedged Firmament hangs its client forever).  Retry policy is
+code-aware:
+
+- lifecycle RPCs retry UNAVAILABLE and DEADLINE_EXCEEDED: they are
+  idempotent by contract (ALREADY_SUBMITTED / ALREADY_EXISTS are
+  tolerated replies — the restart re-play path depends on it);
+- ``Schedule()`` retries UNAVAILABLE only.  A deadline on Schedule is
+  ambiguous — the service may have committed the round and lost the
+  reply — and a blind retry would return the *diff* against the already
+  committed state, silently dropping the lost deltas.  The caller
+  (glue/poseidon.py) owns that case via its suspect reconciler.
 """
 
 from __future__ import annotations
 
+import random
 import time
-from typing import List, Optional
+from typing import FrozenSet, List, Optional
 
 import grpc
 
@@ -49,15 +64,56 @@ _OK = {
     "AddNodeStats": None,
 }
 
+# Transient transport failures worth absorbing with a retry.
+_RETRYABLE: FrozenSet[grpc.StatusCode] = frozenset(
+    (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED)
+)
+_SCHEDULE_RETRYABLE: FrozenSet[grpc.StatusCode] = frozenset(
+    (grpc.StatusCode.UNAVAILABLE,)
+)
+
+
+def rpc_code(e: BaseException) -> Optional[grpc.StatusCode]:
+    """The status code of an RpcError, or None when it carries none
+    (grpc.RpcError itself guarantees nothing; channel errors do)."""
+    code = getattr(e, "code", None)
+    if callable(code):
+        try:
+            return code()
+        except Exception:  # noqa: BLE001 - a broken error object is codeless
+            return None
+    return None
+
 
 class FirmamentClient:
-    """Insecure-channel client, one typed method per RPC."""
+    """Insecure-channel client, one typed method per RPC, with per-RPC
+    deadlines and code-aware bounded retry."""
 
-    def __init__(self, address: str) -> None:
+    def __init__(
+        self,
+        address: str,
+        *,
+        rpc_timeout_s: float = 30.0,
+        rpc_retries: int = 3,
+        rpc_backoff_s: float = 0.05,
+        rpc_backoff_max_s: float = 2.0,
+        retry_seed: int = 0,
+    ) -> None:
         self._channel = grpc.insecure_channel(address)
         self._stubs = make_stubs(
             self._channel, FIRMAMENT_SERVICE, FIRMAMENT_METHODS
         )
+        self.rpc_timeout_s = rpc_timeout_s
+        self.rpc_retries = rpc_retries
+        self.rpc_backoff_s = rpc_backoff_s
+        self.rpc_backoff_max_s = rpc_backoff_max_s
+        # Seeded jitter: chaos soaks re-run bit-for-bit; a production
+        # fleet should pass distinct seeds (or live with per-process
+        # phase alignment — the backoff base still decorrelates rounds).
+        self._jitter = random.Random(retry_seed)
+        # Whether the last successful schedule() burned a retry (its
+        # absorbed UNAVAILABLE may have been post-commit; see schedule).
+        self.schedule_retried = False
 
     def close(self) -> None:
         self._channel.close()
@@ -74,10 +130,60 @@ class FirmamentClient:
             raise FatalReplyError(rpc, reply)
         return reply
 
+    def _invoke(
+        self,
+        rpc: str,
+        request,
+        retry_codes: FrozenSet[grpc.StatusCode] = _RETRYABLE,
+        attempts_out: Optional[list] = None,
+    ):
+        """One RPC with a deadline and bounded, jittered, code-aware
+        retry.  Non-retryable codes (and exhausted budgets) propagate the
+        original error.  ``attempts_out``, when given, receives the
+        number of retries a successful call burned (callers that must
+        distinguish a clean first-try success from a retried one —
+        ``schedule()``'s commit-ambiguity accounting)."""
+        stub = getattr(self._stubs, rpc)
+        attempt = 0
+        while True:
+            try:
+                response = stub(request, timeout=self.rpc_timeout_s or None)
+                if attempts_out is not None:
+                    attempts_out.append(attempt)
+                return response
+            except grpc.RpcError as e:
+                if attempt >= self.rpc_retries or \
+                        rpc_code(e) not in retry_codes:
+                    raise
+                delay = min(
+                    self.rpc_backoff_s * (2 ** attempt),
+                    self.rpc_backoff_max_s,
+                )
+                # Full jitter on [delay/2, delay]: decorrelates a fleet
+                # of clients hammering a recovering service.
+                time.sleep(delay * (0.5 + 0.5 * self._jitter.random()))
+                attempt += 1
+
     # ------------------------------------------------------------------ RPCs
 
     def schedule(self) -> List[fpb.SchedulingDelta]:
-        return list(self._stubs.Schedule(fpb.ScheduleRequest()).deltas)
+        # UNAVAILABLE only: a deadline here is commit-ambiguous (see the
+        # module docstring); the glue's suspect reconciler owns it.
+        # A retried-then-successful call is flagged on
+        # ``schedule_retried``: over a real network UNAVAILABLE can also
+        # surface AFTER the server processed the request (reply lost
+        # mid-stream), in which case the retry silently returned the
+        # diff against the already-committed round — the caller must
+        # treat the window as suspect.  (An UNAVAILABLE that exhausts
+        # every attempt still raises and is treated as pre-commit: gRPC
+        # semantics for a request the service never answered.)
+        attempts: list = []
+        reply = self._invoke(
+            "Schedule", fpb.ScheduleRequest(),
+            retry_codes=_SCHEDULE_RETRYABLE, attempts_out=attempts,
+        )
+        self.schedule_retried = bool(attempts and attempts[0] > 0)
+        return list(reply.deltas)
 
     def task_submitted(
         self, td: fpb.TaskDescriptor, jd: Optional[fpb.JobDescriptor] = None
@@ -86,24 +192,25 @@ class FirmamentClient:
         if jd is not None:
             req.job_descriptor.CopyFrom(jd)
         return self._check(
-            "TaskSubmitted", self._stubs.TaskSubmitted(req).type
+            "TaskSubmitted", self._invoke("TaskSubmitted", req).type
         )
 
     def task_completed(self, uid: int) -> int:
         return self._check(
             "TaskCompleted",
-            self._stubs.TaskCompleted(fpb.TaskUID(task_uid=uid)).type,
+            self._invoke("TaskCompleted", fpb.TaskUID(task_uid=uid)).type,
         )
 
     def task_failed(self, uid: int) -> int:
         return self._check(
-            "TaskFailed", self._stubs.TaskFailed(fpb.TaskUID(task_uid=uid)).type
+            "TaskFailed",
+            self._invoke("TaskFailed", fpb.TaskUID(task_uid=uid)).type,
         )
 
     def task_removed(self, uid: int) -> int:
         return self._check(
             "TaskRemoved",
-            self._stubs.TaskRemoved(fpb.TaskUID(task_uid=uid)).type,
+            self._invoke("TaskRemoved", fpb.TaskUID(task_uid=uid)).type,
         )
 
     def task_updated(
@@ -112,47 +219,77 @@ class FirmamentClient:
         req = fpb.TaskDescription(task_descriptor=td)
         if jd is not None:
             req.job_descriptor.CopyFrom(jd)
-        return self._check("TaskUpdated", self._stubs.TaskUpdated(req).type)
+        return self._check("TaskUpdated", self._invoke("TaskUpdated", req).type)
 
     def node_added(self, rtnd: fpb.ResourceTopologyNodeDescriptor) -> int:
-        return self._check("NodeAdded", self._stubs.NodeAdded(rtnd).type)
+        return self._check("NodeAdded", self._invoke("NodeAdded", rtnd).type)
 
     def node_failed(self, uuid: str) -> int:
         return self._check(
             "NodeFailed",
-            self._stubs.NodeFailed(fpb.ResourceUID(resource_uid=uuid)).type,
+            self._invoke(
+                "NodeFailed", fpb.ResourceUID(resource_uid=uuid)
+            ).type,
         )
 
     def node_removed(self, uuid: str) -> int:
         return self._check(
             "NodeRemoved",
-            self._stubs.NodeRemoved(fpb.ResourceUID(resource_uid=uuid)).type,
+            self._invoke(
+                "NodeRemoved", fpb.ResourceUID(resource_uid=uuid)
+            ).type,
         )
 
     def node_updated(self, rtnd: fpb.ResourceTopologyNodeDescriptor) -> int:
-        return self._check("NodeUpdated", self._stubs.NodeUpdated(rtnd).type)
+        return self._check(
+            "NodeUpdated", self._invoke("NodeUpdated", rtnd).type
+        )
 
     def add_task_stats(self, stats: fpb.TaskStats) -> int:
-        return self._stubs.AddTaskStats(stats).type
+        return self._invoke("AddTaskStats", stats).type
 
     def add_node_stats(self, stats: fpb.ResourceStats) -> int:
-        return self._stubs.AddNodeStats(stats).type
+        return self._invoke("AddNodeStats", stats).type
 
     def check(self) -> int:
-        return self._stubs.Check(fpb.HealthCheckRequest()).status
+        # No internal retry: the start-gate poll loop IS the retry, and
+        # stacking one inside the other would multiply the wait.
+        return self._invoke(
+            "Check", fpb.HealthCheckRequest(), retry_codes=frozenset()
+        ).status
 
     # -------------------------------------------------------------- start gate
 
     def wait_for_service(
         self, timeout: float = 600.0, poll_interval: float = 2.0
     ) -> bool:
-        """Poll Check() until SERVING (poseidon.go:75-88: 2s x <=10min)."""
+        """Poll Check() until SERVING (poseidon.go:75-88: 2s x <=10min).
+
+        The final sleep is clamped to the time remaining — the old loop
+        slept a full ``poll_interval`` past its deadline, which at the
+        reference's 2 s interval stretched short health gates by up to
+        2 s each.  Code-aware: UNAVAILABLE / DEADLINE_EXCEEDED mean "not
+        up yet, keep polling"; any other RpcError code (UNIMPLEMENTED,
+        INVALID_ARGUMENT, ...) means the thing answering is not a
+        Firmament and polling harder will not fix it — raise."""
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        # Each probe carries its own bounded deadline (>= the poll
+        # interval, <= the configured RPC deadline): a black-holed
+        # address must cost one clamped probe, not a full rpc_timeout_s,
+        # per poll.
+        probe_timeout = min(self.rpc_timeout_s or 5.0,
+                            max(poll_interval, 0.1))
+        while True:
             try:
-                if self.check() == fpb.SERVING:
+                status = self._stubs.Check(
+                    fpb.HealthCheckRequest(), timeout=probe_timeout
+                ).status
+                if status == fpb.SERVING:
                     return True
-            except grpc.RpcError:
-                pass
-            time.sleep(poll_interval)
-        return False
+            except grpc.RpcError as e:
+                if rpc_code(e) not in _RETRYABLE:
+                    raise
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            time.sleep(min(poll_interval, remaining))

@@ -100,18 +100,29 @@ class FirmamentServicer:
 
     # ------------------------------------------------------------- scheduling
 
-    def Schedule(self, request, context):
+    def ensure_precompiled(self) -> int:
+        """Compile the (E_bucket, M_bucket) solver ladder up to the
+        configured ceilings, exactly once (idempotent, serialized on the
+        schedule lock).  The first Schedule() calls this lazily; harness
+        code that measures per-round fresh compiles (the chaos soak)
+        calls it eagerly instead — a lazy precompile keeps running in
+        the first round's handler thread after the client's deadline
+        expires, and its compile-completion events then straggle into
+        later rounds' ledger windows."""
         with self._schedule_lock:
-            if self.config.precompile and not self._precompiled:
-                # Compile the (E_bucket, M_bucket) solver ladder up to the
-                # configured ceilings before the first round, so churn
-                # rounds never pay first-compile latency.
-                self._precompiled = True
-                n = self.planner.precompile(
-                    max_ecs=self.config.max_ecs,
-                    max_machines=self.config.max_machines,
-                )
-                log.info("precompiled %d solver shapes", n)
+            if not self.config.precompile or self._precompiled:
+                return 0
+            self._precompiled = True
+            n = self.planner.precompile(
+                max_ecs=self.config.max_ecs,
+                max_machines=self.config.max_machines,
+            )
+            log.info("precompiled %d solver shapes", n)
+            return n
+
+    def Schedule(self, request, context):
+        self.ensure_precompiled()
+        with self._schedule_lock:
             if self.config.profile_dir:
                 import jax
 

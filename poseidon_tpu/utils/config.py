@@ -29,6 +29,19 @@ class PoseidonConfig:
     kube_version: str = "1.6"
     stats_server_address: str = "0.0.0.0:9091"
     scheduling_interval: float = 10.0  # seconds; config.go:120
+    # RPC hardening (the reference has none of these: its client blocks
+    # forever on a wedged Firmament): per-RPC deadline, bounded retry
+    # with exponential backoff + jitter (service/client.py).
+    rpc_timeout_s: float = 30.0
+    rpc_retries: int = 3
+    rpc_backoff_s: float = 0.05
+    # Crash-loop budget for the schedule loop (glue/poseidon.py): after
+    # this many CONSECUTIVE failed rounds the loop stops fatally instead
+    # of log-and-spin; failed rounds back off exponentially from
+    # crash_backoff_s up to crash_backoff_max_s between retries.
+    crash_loop_budget: int = 8
+    crash_backoff_s: float = 0.5
+    crash_backoff_max_s: float = 30.0
     config_file: str = ""
 
     def kube_version_tuple(self) -> tuple:

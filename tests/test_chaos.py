@@ -1,0 +1,696 @@
+"""Chaos subsystem: fault plans, injection seams, hardening regressions.
+
+Covers the deterministic fault-plan contract, the client's
+deadline/retry/backoff hardening (against injected faults passing
+through the REAL retry path), the glue's crash-loop budget,
+transactional bind rollback, watcher resync, the planner's degraded
+solve tier, and a full tiny soak (every fault family through the whole
+stack).  The cluster-scale soak smoke lives in tests/test_soak_smoke.py
+(slow tier, ``make soak-smoke``).
+"""
+
+import threading
+import time
+
+import grpc
+import pytest
+
+from poseidon_tpu.chaos import (
+    ChaoticKube,
+    FaultInjector,
+    InjectedRpcError,
+    chaotic_client,
+    named_plan,
+    run_soak,
+)
+from poseidon_tpu.chaos.plan import FAMILIES, Fault, FaultPlan
+from poseidon_tpu.glue import FakeKube, Node, Pod, Poseidon
+from poseidon_tpu.graph.state import TaskState
+from poseidon_tpu.service import FirmamentTPUServer
+from poseidon_tpu.service.client import FirmamentClient
+from poseidon_tpu.utils.config import PoseidonConfig
+
+
+# ------------------------------------------------------------------ the plan
+
+
+class TestFaultPlan:
+    def test_seed_reproducible(self):
+        a = FaultPlan.generate("t", seed=7, rounds=12)
+        b = FaultPlan.generate("t", seed=7, rounds=12)
+        assert a == b
+        assert FaultPlan.generate("t", seed=8, rounds=12) != a
+
+    def test_roundtrip_and_round_lookup(self):
+        plan = named_plan("smoke", 10, seed=3)
+        assert FaultPlan.from_json(plan.to_json()) == plan
+        listed = [f for r in range(10) for f in plan.for_round(r)]
+        assert sorted(listed, key=lambda f: (f.round_index, f.kind)) == \
+            sorted(plan.faults, key=lambda f: (f.round_index, f.kind))
+
+    def test_smoke_plan_covers_every_family(self):
+        plan = named_plan("smoke", 10, seed=0)
+        assert plan.families_covered() == tuple(sorted(FAMILIES))
+
+    def test_quiet_head_round_zero_fault_free(self):
+        for seed in range(5):
+            plan = named_plan("smoke", 10, seed=seed)
+            assert plan.for_round(0) == []
+
+    def test_unknown_plan_and_kind(self):
+        with pytest.raises(KeyError):
+            named_plan("nope", 5)
+        with pytest.raises(ValueError):
+            FaultPlan.generate("t", 0, 5, kinds=("not_a_kind",))
+
+
+# ------------------------------------------------- client deadline/retry/backoff
+
+
+def _plan_with(*faults: Fault) -> FaultPlan:
+    return FaultPlan(name="test", seed=0, rounds=32, faults=tuple(faults))
+
+
+@pytest.fixture()
+def server():
+    with FirmamentTPUServer(address="127.0.0.1:0") as srv:
+        yield srv
+
+
+def test_client_retry_absorbs_unavailable(server):
+    injector = FaultInjector(_plan_with(
+        Fault(0, "rpc_unavailable", target="TaskSubmitted"),
+        Fault(0, "rpc_unavailable", target="TaskSubmitted"),
+    ))
+    injector.begin_round(0)
+    client = chaotic_client(
+        server.address, injector,
+        rpc_retries=3, rpc_backoff_s=0.005, rpc_backoff_max_s=0.01,
+    )
+    from poseidon_tpu.protos import firmament_pb2 as fpb
+
+    td = fpb.TaskDescriptor(uid=1, name="p", job_id="j")
+    assert client.task_submitted(td) == fpb.TASK_SUBMITTED_OK
+    fired = [e["kind"] for e in injector.fired]
+    assert fired.count("rpc_unavailable") == 2  # both absorbed by retry
+    client.close()
+
+
+def test_client_retry_budget_exhausts(server):
+    faults = tuple(
+        Fault(0, "rpc_unavailable", target="TaskSubmitted")
+        for _ in range(5)
+    )
+    injector = FaultInjector(_plan_with(*faults))
+    injector.begin_round(0)
+    client = chaotic_client(
+        server.address, injector,
+        rpc_retries=1, rpc_backoff_s=0.005, rpc_backoff_max_s=0.01,
+    )
+    from poseidon_tpu.protos import firmament_pb2 as fpb
+
+    with pytest.raises(grpc.RpcError):
+        client.task_submitted(fpb.TaskDescriptor(uid=1, name="p"))
+    client.close()
+
+
+def test_schedule_does_not_retry_deadline(server):
+    """A deadline on Schedule is commit-ambiguous: the client must raise,
+    not blind-retry (the glue's suspect reconciler owns the heal)."""
+    injector = FaultInjector(_plan_with(
+        Fault(0, "rpc_deadline", target="Schedule"),
+    ))
+    injector.begin_round(0)
+    client = chaotic_client(
+        server.address, injector, rpc_retries=3, rpc_backoff_s=0.005,
+    )
+    with pytest.raises(grpc.RpcError):
+        client.schedule()
+    # The fault fired exactly once: no retry consumed a second one.
+    assert [e["kind"] for e in injector.fired] == ["rpc_deadline"]
+    # UNAVAILABLE on Schedule IS retried (pre-commit by definition).
+    injector2 = FaultInjector(_plan_with(
+        Fault(0, "rpc_unavailable", target="Schedule"),
+    ))
+    injector2.begin_round(0)
+    client2 = chaotic_client(
+        server.address, injector2, rpc_retries=2, rpc_backoff_s=0.005,
+    )
+    assert client2.schedule() == []
+    client.close()
+    client2.close()
+
+
+def test_wait_for_service_clamps_final_sleep():
+    """Regression (satellite 1): the poll loop used to sleep a full
+    poll_interval past its deadline."""
+    client = FirmamentClient("127.0.0.1:1", rpc_timeout_s=0.5)
+    t0 = time.monotonic()
+    assert client.wait_for_service(timeout=0.5, poll_interval=0.4) is False
+    elapsed = time.monotonic() - t0
+    # Old behavior: ~0.5 + full 0.4 sleep past the deadline.  New: the
+    # final sleep is clamped to the remaining ~0.1 s.
+    assert elapsed < 0.85, elapsed
+    client.close()
+
+
+def test_wait_for_service_raises_on_non_transient_code(server):
+    """UNAVAILABLE keeps polling; any other code raises (satellite 1)."""
+    client = FirmamentClient(server.address)
+
+    def bad_check(request, timeout=None):
+        raise InjectedRpcError(grpc.StatusCode.UNIMPLEMENTED, "not firmament")
+
+    client._stubs.Check = bad_check
+    with pytest.raises(grpc.RpcError):
+        client.wait_for_service(timeout=1.0, poll_interval=0.05)
+    client.close()
+
+
+# ------------------------------------------------------------ crash-loop budget
+
+
+class _AlwaysFailingClient:
+    """The minimal client surface Poseidon touches, with a schedule()
+    that always raises (a permanently dead Firmament)."""
+
+    calls = 0
+
+    def schedule(self):
+        self.calls += 1
+        raise InjectedRpcError(grpc.StatusCode.UNAVAILABLE, "dead")
+
+    def wait_for_service(self, timeout=0.0, poll_interval=0.0):
+        return True
+
+
+def _budget_poseidon(budget=3):
+    cfg = PoseidonConfig(
+        crash_loop_budget=budget, crash_backoff_s=0.001,
+        crash_backoff_max_s=0.004, scheduling_interval=0.01,
+    )
+    return Poseidon(
+        FakeKube(), config=cfg, firmament=_AlwaysFailingClient(),
+        run_loop=False,
+    )
+
+
+def test_crash_loop_budget_fatal_stop():
+    """Regression (satellite 2): the loop used to swallow every round
+    failure forever; now consecutive failures are budgeted, backed off,
+    and fatally stopped with a clear reason."""
+    p = _budget_poseidon(budget=3)
+    d1 = p.try_round()
+    d2 = p.try_round()
+    assert d1 is not None and d2 is not None
+    assert 0 < d1 <= 0.002  # backoff base, jittered into [base/2, base]
+    assert d2 >= d1 * 0.5   # exponential growth modulo jitter
+    assert p.loop_stats.consecutive_failures == 2
+    assert p.fatal is None
+    assert p.try_round() is None           # budget exhausted
+    assert p.fatal is not None and "crash-loop budget" in p.fatal
+    assert p._stop.is_set()
+    assert p.loop_stats.failed_rounds == 3
+
+
+def test_crash_loop_budget_resets_on_success(server):
+    kube = FakeKube()
+    cfg = PoseidonConfig(
+        firmament_address=server.address, scheduling_interval=3600,
+        crash_loop_budget=3, crash_backoff_s=0.001,
+    )
+    p = Poseidon(kube, config=cfg, run_loop=False)
+    p.fc.close()
+    p.fc = _AlwaysFailingClient()
+    assert p.try_round() is not None
+    assert p.loop_stats.consecutive_failures == 1
+    # Service recovers: the healthy round resets the budget.
+    p.fc = FirmamentClient(server.address)
+    assert p.try_round() == cfg.scheduling_interval
+    assert p.loop_stats.consecutive_failures == 0
+    p.fc.close()
+
+
+def test_loop_thread_exits_on_exhausted_budget():
+    p = _budget_poseidon(budget=2)
+    t = threading.Thread(target=p._loop, daemon=True)
+    t.start()
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    assert p.fatal is not None
+
+
+# ------------------------------------------------------- the chaotic full stack
+
+
+@pytest.fixture()
+def chaotic_system():
+    """Full stack with injection seams armed by a per-test plan: the
+    test sets ``injector.plan`` faults via begin_round on a plan it
+    builds, or pokes the injector hooks directly."""
+    with FirmamentTPUServer(address="127.0.0.1:0") as srv:
+        injector = FaultInjector(_plan_with())
+        kube = ChaoticKube(FakeKube(), injector)
+        client = chaotic_client(
+            srv.address, injector,
+            rpc_timeout_s=10.0, rpc_retries=2, rpc_backoff_s=0.005,
+        )
+        cfg = PoseidonConfig(
+            firmament_address=srv.address, scheduling_interval=3600,
+            crash_loop_budget=4, crash_backoff_s=0.005,
+            crash_backoff_max_s=0.01,
+        )
+        poseidon = Poseidon(
+            kube, config=cfg, firmament=client, run_loop=False
+        ).start(health_timeout=10)
+        srv.servicer.planner.chaos = injector
+        try:
+            yield kube, poseidon, srv, injector
+        finally:
+            poseidon.stop()
+
+
+def _views(kube, poseidon, srv):
+    from poseidon_tpu.chaos.soak import _placement_views
+
+    return _placement_views(kube, poseidon, srv)
+
+
+def test_bind_failure_rolls_back_and_requeues(chaotic_system):
+    """Transactional enactment: a PLACE whose bind fails must requeue
+    the pod and roll the scheduler view back — no divergence, and the
+    pod places cleanly next round."""
+    kube, poseidon, srv, injector = chaotic_system
+    kube.add_node(Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.create_pod(Pod(name="p1", cpu_request=100, ram_request=1 << 18))
+    assert poseidon.drain_watchers()
+    injector.plan = _plan_with(Fault(0, "bind_fail", value=1))
+    injector.begin_round(0)
+    poseidon.schedule_once()
+    assert poseidon.loop_stats.bind_failures == 1
+    assert poseidon.loop_stats.requeued == 1
+    assert kube.inner.pods["default/p1"].phase == "Pending"
+    # Scheduler rolled back: the task is runnable again, not placed.
+    uid = poseidon.shared.uid_for_pod("default/p1")
+    task = srv.servicer.state.tasks[uid]
+    assert task.state == TaskState.RUNNABLE and task.scheduled_to is None
+    kube_truth, sched_view = _views(kube, poseidon, srv)
+    assert kube_truth == sched_view == {}
+    # Fault consumed: the next round places for real.
+    injector.begin_round(1)
+    poseidon.schedule_once()
+    assert kube.inner.pods["default/p1"].phase == "Running"
+    kube_truth, sched_view = _views(kube, poseidon, srv)
+    assert kube_truth == sched_view == {"default/p1": "n1"}
+
+
+def test_schedule_lost_heals_via_reconciler(chaotic_system):
+    """The nastiest fault: Schedule() commits on the service and the
+    reply is lost.  The glue marks the window suspect and the next
+    successful round requeues the phantom placements — the views
+    reconverge within one healthy round."""
+    kube, poseidon, srv, injector = chaotic_system
+    kube.add_node(Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.create_pod(Pod(name="p1", cpu_request=100, ram_request=1 << 18))
+    assert poseidon.drain_watchers()
+    injector.plan = _plan_with(Fault(0, "schedule_lost"))
+    injector.begin_round(0)
+    with pytest.raises(grpc.RpcError):
+        poseidon.schedule_once()
+    # Divergence is real at this instant: service placed, kube did not.
+    kube_truth, sched_view = _views(kube, poseidon, srv)
+    assert kube_truth == {} and sched_view != {}
+    injector.begin_round(1)
+    poseidon.schedule_once()   # suspect round: reconciler requeues
+    assert poseidon.loop_stats.requeued == 1
+    poseidon.schedule_once()   # re-placement enacts
+    assert kube.inner.pods["default/p1"].phase == "Running"
+    kube_truth, sched_view = _views(kube, poseidon, srv)
+    assert kube_truth == sched_view != {}
+
+
+def test_watch_disconnect_resyncs(chaotic_system):
+    """A dropped watch (stale resourceVersion) must resync: the watcher
+    re-lists, re-subscribes, and keeps scheduling."""
+    kube, poseidon, srv, injector = chaotic_system
+    kube.add_node(Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24))
+    assert poseidon.drain_watchers()
+    injector.plan = _plan_with(Fault(0, "disconnect_pods"))
+    injector.begin_round(0)
+    # Let the pump observe the disconnect and resync (<= one 0.2s poll).
+    deadline = time.monotonic() + 5.0
+    while poseidon.pod_watcher.resyncs == 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert poseidon.pod_watcher.resyncs == 1
+    kube.create_pod(Pod(name="p1", cpu_request=100, ram_request=1 << 18))
+    assert poseidon.drain_watchers()
+    poseidon.schedule_once()
+    assert kube.inner.pods["default/p1"].phase == "Running"
+
+
+def test_resync_synthesizes_missed_deletions(chaotic_system):
+    """Pods/nodes that vanished while the watch was down must be
+    DELETED-synthesized from the re-list diff, or the scheduler keeps
+    phantom objects forever."""
+    kube, poseidon, srv, injector = chaotic_system
+    kube.add_node(Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.add_node(Node(name="n2", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.create_pod(Pod(name="p1", cpu_request=100, ram_request=1 << 18))
+    kube.create_pod(Pod(name="p2", cpu_request=100, ram_request=1 << 18))
+    assert poseidon.drain_watchers()
+    poseidon.schedule_once()
+    # Quiesce the bind MODIFIED events first: a real disconnect drops
+    # in-flight events WITH the watch, so none can trail the resync.
+    assert poseidon.drain_watchers()
+    # Simulate a deletion the watch never saw: remove from the registry
+    # without emitting an event (the disconnected-window loss).
+    del kube.inner.pods["default/p2"]
+    poseidon.pod_watcher._resync()
+    assert poseidon.drain_watchers()
+    assert poseidon.shared.uid_for_pod("default/p2") is None
+    assert poseidon.shared.uid_for_pod("default/p1") is not None
+    # Same for nodes: n2 vanishes; its resource must leave the scheduler.
+    del kube.inner.nodes["n2"]
+    poseidon.node_watcher._resync()
+    assert poseidon.drain_watchers()
+    assert poseidon.shared.get_node("n2") is None
+    assert poseidon.shared.get_node("n1") is not None
+
+
+def test_resync_applies_missed_spec_change(chaotic_system):
+    """A spec MODIFIED lost inside the watch outage must land via the
+    resync's MODIFIED replay — an ADDED replay is ignored for a pod the
+    watcher already knows, leaving the scheduler solving against the
+    stale descriptor forever."""
+    kube, poseidon, srv, injector = chaotic_system
+    kube.add_node(Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.create_pod(Pod(name="p1", cpu_request=100, ram_request=1 << 18))
+    assert poseidon.drain_watchers()
+    uid = poseidon.shared.uid_for_pod("default/p1")
+    td = poseidon.shared.get_task(uid).descriptor
+    assert td.resource_request.cpu_cores == 100
+    # Mutate the spec without an event: the MODIFIED died with the watch.
+    kube.inner.pods["default/p1"].cpu_request = 250
+    poseidon.pod_watcher._resync()
+    assert poseidon.drain_watchers()
+    td = poseidon.shared.get_task(uid).descriptor
+    assert td.resource_request.cpu_cores == 250
+
+
+def test_resync_unsubscribes_dead_watch(chaotic_system):
+    """The dead watch must leave the fan-out registry on resync, or
+    every later mutation keeps copying events into abandoned queues."""
+    kube, poseidon, srv, injector = chaotic_system
+    kube.add_node(Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24))
+    assert poseidon.drain_watchers()
+    before = len(kube.inner._pod_watchers)
+    injector.plan = _plan_with(Fault(0, "disconnect_pods"))
+    injector.begin_round(0)
+    deadline = time.monotonic() + 5.0
+    while poseidon.pod_watcher.resyncs == 0 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert poseidon.pod_watcher.resyncs == 1
+    assert len(kube.inner._pod_watchers) == before
+
+
+def test_half_rolled_back_requeue_replays_next_round(chaotic_system):
+    """A bind rollback whose resubmit RPC fails must park the descriptor
+    and replay it next round — otherwise the task exists nowhere (removed
+    server-side, pod Pending in kube) and nothing ever heals it.  The
+    suspect flag must also survive the mid-enactment raise."""
+    kube, poseidon, srv, injector = chaotic_system
+    kube.add_node(Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.create_pod(Pod(name="p1", cpu_request=100, ram_request=1 << 18))
+    assert poseidon.drain_watchers()
+    uid = poseidon.shared.uid_for_pod("default/p1")
+    # One bind failure; the rollback's TaskSubmitted exhausts the retry
+    # budget (rpc_retries=2 -> 3 attempts).
+    injector.plan = _plan_with(
+        Fault(0, "bind_fail", value=1),
+        Fault(0, "rpc_unavailable", target="TaskSubmitted"),
+        Fault(0, "rpc_unavailable", target="TaskSubmitted"),
+        Fault(0, "rpc_unavailable", target="TaskSubmitted"),
+    )
+    injector.begin_round(0)
+    with pytest.raises(grpc.RpcError):
+        poseidon.schedule_once()
+    assert uid in poseidon._resubmit_pending
+    assert poseidon.loop_stats.bind_failures == 1
+    # A mid-enactment abort arms the reconciler (the round's remaining
+    # committed deltas are orphaned phantoms until it runs).
+    assert poseidon._schedule_suspect is True
+    # Clean round: the parked resubmit replays first, the round places
+    # the pod, and the suspect window closes.
+    injector.begin_round(1)
+    poseidon.schedule_once()
+    assert poseidon._resubmit_pending == {}
+    assert poseidon._schedule_suspect is False
+    assert kube.inner.pods["default/p1"].phase == "Running"
+    kube_truth, sched_view = _views(kube, poseidon, srv)
+    assert kube_truth == sched_view == {"default/p1": "n1"}
+
+
+def test_mid_enactment_abort_heals_orphaned_deltas(chaotic_system):
+    """A round that dies mid-enactment leaves its un-enacted PLACE
+    deltas committed server-side with their pods Pending — the suspect
+    reconciler (armed by the abort) must requeue and re-place them."""
+    kube, poseidon, srv, injector = chaotic_system
+    kube.add_node(Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.create_pod(Pod(name="p1", cpu_request=100, ram_request=1 << 18))
+    kube.create_pod(Pod(name="p2", cpu_request=100, ram_request=1 << 18))
+    assert poseidon.drain_watchers()
+    # The first PLACE's bind fails and its rollback's resubmit RPC dies
+    # too: enactment aborts, so the round's OTHER placement (committed
+    # on the service) is never bound in kube.
+    injector.plan = _plan_with(
+        Fault(0, "bind_fail", value=1),
+        Fault(0, "rpc_unavailable", target="TaskSubmitted"),
+        Fault(0, "rpc_unavailable", target="TaskSubmitted"),
+        Fault(0, "rpc_unavailable", target="TaskSubmitted"),
+    )
+    injector.begin_round(0)
+    with pytest.raises(grpc.RpcError):
+        poseidon.schedule_once()
+    assert poseidon._schedule_suspect is True
+    injector.begin_round(1)
+    # Clean rounds: flush the parked resubmit, reconcile the phantom,
+    # re-place everything.
+    for _ in range(3):
+        poseidon.schedule_once()
+    assert kube.inner.pods["default/p1"].phase == "Running"
+    assert kube.inner.pods["default/p2"].phase == "Running"
+    kube_truth, sched_view = _views(kube, poseidon, srv)
+    assert kube_truth == sched_view
+    assert len(kube_truth) == 2
+
+
+def test_retried_schedule_marks_window_suspect(chaotic_system):
+    """An UNAVAILABLE absorbed by Schedule's retry can, on a real
+    network, hide a post-commit reply loss: the retried call must arm
+    the suspect window (healed within the same fully-enacted round)."""
+    kube, poseidon, srv, injector = chaotic_system
+    kube.add_node(Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.create_pod(Pod(name="p1", cpu_request=100, ram_request=1 << 18))
+    assert poseidon.drain_watchers()
+    injector.plan = _plan_with(
+        Fault(0, "rpc_unavailable", target="Schedule"),
+    )
+    injector.begin_round(0)
+    poseidon.schedule_once()
+    assert poseidon.fc.schedule_retried is True
+    # The window armed and the same round's reconcile closed it.
+    assert poseidon._schedule_suspect is False
+    assert kube.inner.pods["default/p1"].phase == "Running"
+    injector.begin_round(1)
+    poseidon.schedule_once()
+    assert poseidon.fc.schedule_retried is False
+
+
+def test_enacted_map_pruned_after_lifecycle_end(chaotic_system):
+    """The enacted map must not grow one entry per pod ever placed:
+    tasks that finished or left the cluster leave it on the next
+    round."""
+    kube, poseidon, srv, injector = chaotic_system
+    kube.add_node(Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.create_pod(Pod(name="p1", cpu_request=100, ram_request=1 << 18))
+    assert poseidon.drain_watchers()
+    poseidon.schedule_once()
+    uid = poseidon.shared.uid_for_pod("default/p1")
+    assert uid in poseidon._enacted
+    kube.set_pod_phase("default/p1", "Succeeded")
+    assert poseidon.drain_watchers()
+    poseidon.schedule_once()
+    assert uid not in poseidon._enacted
+
+
+def test_unavailable_schedule_failure_is_not_suspect(chaotic_system):
+    """UNAVAILABLE is pre-commit by contract: it must NOT arm the
+    suspect reconciler (a sweep over the whole pending backlog), and the
+    failed round must attribute no deltas to itself."""
+    kube, poseidon, srv, injector = chaotic_system
+    kube.add_node(Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.create_pod(Pod(name="p1", cpu_request=100, ram_request=1 << 18))
+    assert poseidon.drain_watchers()
+    injector.plan = _plan_with(
+        Fault(0, "rpc_unavailable", target="Schedule"),
+        Fault(0, "rpc_unavailable", target="Schedule"),
+        Fault(0, "rpc_unavailable", target="Schedule"),
+    )
+    injector.begin_round(0)
+    with pytest.raises(grpc.RpcError):
+        poseidon.schedule_once()
+    assert poseidon._schedule_suspect is False
+    assert poseidon.last_deltas == []
+    injector.begin_round(1)
+    poseidon.schedule_once()
+    # No reconcile sweep fired: nothing was requeued on the clean round.
+    assert poseidon.loop_stats.requeued == 0
+    assert kube.inner.pods["default/p1"].phase == "Running"
+
+
+def test_stop_while_round_in_flight(chaotic_system):
+    """Satellite 3: stop() during an in-flight round must let the round
+    finish enacting, then stop the loop cleanly — no torn enactment, no
+    hung join."""
+    kube, poseidon, srv, injector = chaotic_system
+    kube.add_node(Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24))
+    kube.create_pod(Pod(name="p1", cpu_request=100, ram_request=1 << 18))
+    assert poseidon.drain_watchers()
+    injector.hold_schedule = threading.Event()
+    loop = threading.Thread(target=poseidon._loop, daemon=True)
+    poseidon._loop_thread = loop
+    loop.start()
+    assert injector.in_schedule.wait(timeout=10.0)
+    stopper = threading.Thread(target=poseidon.stop)
+    stopper.start()
+    time.sleep(0.1)            # stop() is now joining the blocked loop
+    injector.hold_schedule.set()
+    stopper.join(timeout=10.0)
+    loop.join(timeout=10.0)
+    assert not loop.is_alive()
+    # The in-flight round completed its enactment before the loop exited.
+    assert poseidon.loop_stats.rounds == 1
+    assert kube.inner.pods["default/p1"].phase == "Running"
+
+
+def test_drain_watchers_timeout_expires():
+    """Satellite 3: drain_watchers must report False (not hang) when a
+    queue never empties — here a key held in processing forever."""
+    cfg = PoseidonConfig(scheduling_interval=3600)
+    p = Poseidon(
+        FakeKube(), config=cfg, firmament=_AlwaysFailingClient(),
+        run_loop=False,
+    )
+    p.pod_watcher.queue.add("default/p", ("ADDED", object()))
+    p.pod_watcher.queue.get()   # processing, never done()
+    t0 = time.monotonic()
+    assert p.drain_watchers(timeout=0.3) is False
+    assert time.monotonic() - t0 < 2.0
+
+
+# ---------------------------------------------------------- degraded solve tier
+
+
+def _tiny_state(tasks=6):
+    from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+    from poseidon_tpu.utils.ids import generate_uuid, task_uid
+
+    state = ClusterState()
+    for i in range(4):
+        state.node_added(MachineInfo(
+            uuid=generate_uuid(f"deg-m{i}"), cpu_capacity=32000,
+            ram_capacity=128 << 20, task_slots=16,
+        ))
+    for i in range(tasks):
+        state.task_submitted(TaskInfo(
+            uid=task_uid("deg", i), job_id="deg-j",
+            cpu_request=400, ram_request=1 << 19,
+        ))
+    return state
+
+
+class _SolverChaos:
+    def __init__(self, forced=False, frac=None):
+        self.forced = forced
+        self.frac = frac
+
+    def solver_fault(self):
+        return self.forced, self.frac
+
+
+def test_degraded_tier_forced_uncertified():
+    """Injected certificate failure escalates to the host-greedy tier:
+    feasible deterministic placements, converged=False, tier recorded;
+    the next clean round goes back to a certified tier."""
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+    from poseidon_tpu.graph.state import TaskInfo
+    from poseidon_tpu.utils.ids import task_uid
+
+    state = _tiny_state()
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+    planner.chaos = _SolverChaos(forced=True)
+    deltas, m = planner.schedule_round()
+    assert m.solve_tier == "host_greedy"
+    assert not m.converged
+    assert m.placed == 6 and m.unscheduled == 0
+    planner.chaos = _SolverChaos(forced=False)
+    state.task_submitted(TaskInfo(
+        uid=task_uid("deg", 99), job_id="deg-j",
+        cpu_request=400, ram_request=1 << 19,
+    ))
+    _, m2 = planner.schedule_round()
+    assert m2.solve_tier in ("pruned", "dense")
+    assert m2.converged
+
+
+def test_degraded_tier_partial_round():
+    """The partial-Schedule-response fault places only a fraction; the
+    rest stays pending and lands once the fault clears."""
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+
+    state = _tiny_state(tasks=8)
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+    planner.chaos = _SolverChaos(frac=0.5)
+    _, m = planner.schedule_round()
+    assert m.solve_tier == "host_greedy"
+    assert m.placed == 4 and m.unscheduled == 4
+    planner.chaos = None
+    _, m2 = planner.schedule_round()
+    assert m2.placed == 4 and m2.unscheduled == 0
+    assert m2.converged
+
+
+def test_quiet_round_tier():
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+
+    state = _tiny_state()
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+    _, m = planner.schedule_round()
+    assert m.solve_tier in ("pruned", "dense")
+    _, m2 = planner.schedule_round()
+    assert m2.solve_tier == "quiet"
+
+
+# ------------------------------------------------------------- tiny full soak
+
+
+def test_tiny_soak_all_families(tmp_path):
+    """The whole stack under the smoke plan at toy scale: every family
+    fires, zero divergence, zero warm compiles, everything places."""
+    out = run_soak(
+        machines=12, rounds=6, plan="smoke", seed=0,
+        out_dir=str(tmp_path),
+    )
+    assert out["ok"], out.get("failure")
+    fired_families = {
+        f.family
+        for f in named_plan("smoke", 6, seed=0).faults
+        if any(e["kind"] == f.kind for e in out["fired"])
+    }
+    assert {"watch", "events", "rpc", "binding", "solver"} <= fired_families
+    assert out["warm_fresh_compiles"] == 0
+    assert out["divergent_rounds"] == 0
+    assert "host_greedy" in out["tiers"]

@@ -43,6 +43,7 @@ def _findings(rule: str, fixture: str):
         ("jit-purity", "jit_purity_clean.py"),
         ("lock-discipline", "lock_discipline_clean.py"),
         ("determinism", "determinism_clean.py"),
+        ("determinism", "chaos_plan_clean.py"),
         ("retrace-guard", "retrace_guard_clean.py"),
     ],
 )
@@ -91,6 +92,28 @@ def test_determinism_violations():
     assert sum("without a seed" in m for m in msgs) == 1
     assert sum("unordered set" in m for m in msgs) == 5
     assert sum("import time" in m for m in msgs) == 4
+
+
+def test_chaos_determinism_violations():
+    """Satellite (PR 5): the determinism rule scans chaos/ — fault
+    plans must be seed-reproducible, so wall-clock timing, OS-entropy
+    RNG, and set-ordered fault output are lint failures there."""
+    found = _findings("determinism", "chaos_plan_violations.py")
+    msgs = [f.message for f in found]
+    assert len(found) == 7
+    assert sum("wall-clock" in m for m in msgs) == 2
+    assert sum("unseeded global RNG" in m for m in msgs) == 2
+    assert sum("without a seed" in m for m in msgs) == 1
+    assert sum("unordered set" in m for m in msgs) == 2
+
+
+def test_determinism_scope_covers_chaos():
+    from poseidon_tpu.check.determinism import DeterminismRule
+
+    rule = DeterminismRule()
+    assert rule.applies_to("poseidon_tpu/chaos/plan.py")
+    assert rule.applies_to("poseidon_tpu/chaos/soak.py")
+    assert not rule.applies_to("poseidon_tpu/glue/poseidon.py")
 
 
 def test_retrace_guard_violations():
