@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: all test test-fast bench protos native verify lint lint-fast \
-  bench-smoke soak-smoke demo demo-stop clean
+  bench-smoke soak-smoke trace-smoke perf-gate demo demo-stop clean
 
 all: protos native lint test
 
@@ -35,6 +35,29 @@ bench-smoke:
 # `make clean`).
 soak-smoke:
 	$(PY) -m pytest tests/test_soak_smoke.py -q -m slow -p no:cacheprovider
+
+# Observability smoke (docs/OBSERVABILITY.md): one features-config
+# round with POSEIDON_TRACE=1, exported to out/trace_smoke.json and
+# validated — Perfetto-loadable format, round->stage span nesting, and
+# span/stagetimer parity within 5%.
+trace-smoke:
+	$(PY) tools/trace_smoke.py
+
+# Perf-regression gate (tools/bench_compare.py): diff a fresh bench
+# artifact's timing series (headline p50s + per-stage features timings)
+# against the committed round baseline; fail past the tolerance band.
+# Point PERF_BENCH at the fresh artifact (bench.py writes superset
+# JSON lines; the last parseable one wins):
+#   python bench.py > out/bench_gate.jsonl && make perf-gate
+PERF_BENCH ?= out/bench_gate.jsonl
+# First parseable baseline wins.  bench_r06_baseline.json is the first
+# committed artifact carrying the per-stage features series
+# (mask/cost/solve/view) — without it those rows fall in "skipped" and
+# only headline round timings are gated.
+PERF_BASELINES = --baseline docs/bench_r06_baseline.json \
+  --baseline docs/bench_r05_final.json
+perf-gate:
+	$(PY) tools/bench_compare.py $(PERF_BASELINES) --current $(PERF_BENCH)
 
 protos:
 	$(PY) -m poseidon_tpu.protos.gen
@@ -67,9 +90,14 @@ lint-fast:
 	$(PY) -m poseidon_tpu.check --changed poseidon_tpu/
 
 # Entry-point smoke: compile check + multichip dryrun + demo loop, with
-# the two behavior smokes (feature semantics + chaos robustness) gating
-# alongside static analysis.
-verify: lint bench-smoke soak-smoke
+# the behavior smokes (feature semantics + chaos robustness + traced
+# round) gating alongside static analysis.  The perf gate runs in
+# WARN-ONLY mode here: verify must stay green on machines without a
+# fresh bench artifact, but a committed artifact that regressed past
+# the band gets called out in the log.
+verify: lint bench-smoke soak-smoke trace-smoke
+	$(PY) tools/bench_compare.py $(PERF_BASELINES) --current $(PERF_BENCH) \
+	  --warn-only
 	$(PY) __graft_entry__.py
 
 # Backgrounded demo loop with its PID on record (out/demo.pid), so the
@@ -94,4 +122,5 @@ demo-stop:
 clean: demo-stop
 	rm -f poseidon_tpu/native/_graphcore.so
 	rm -rf out/soak
+	rm -f out/trace_smoke.json out/trace_features.json out/bench_gate.jsonl
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -518,6 +518,7 @@ def run_features(machines: int, rounds: int) -> dict:
     from poseidon_tpu.costmodel.selectors import IN_SET
     from poseidon_tpu.graph.instance import RoundPlanner
     from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+    from poseidon_tpu.obs import trace as obs_trace
     from poseidon_tpu.utils import stagetimer
     from poseidon_tpu.utils.ids import generate_uuid, task_uid
 
@@ -663,6 +664,10 @@ def run_features(machines: int, rounds: int) -> dict:
         "targets": n_targets,
         "colocated": colocated,
         "fresh_compiles": ma.fresh_compiles,
+        # Full round metrics in the one schema-versioned wire format
+        # (RoundMetrics.to_dict — same dict the flight recorder and the
+        # Prometheus exporter consume).
+        "round_metrics": ma.to_dict(),
         **_stage_timings(),
     }
     print(json.dumps(out), flush=True)
@@ -736,8 +741,16 @@ def run_features(machines: int, rounds: int) -> dict:
             "price_out_rounds": mg.pruned_price_out_rounds,
             "escalations": mg.pruned_escalations,
         },
+        "round_metrics": mg.to_dict(),
         **_stage_timings(),
     }
+    # With POSEIDON_TRACE=1 the whole features run recorded spans
+    # (round -> mask/cost/solve/view stage nesting): export the
+    # Perfetto-loadable artifact next to the numbers.
+    if obs_trace.tracing_enabled():
+        trace_path = os.path.join("out", "trace_features.json")
+        obs_trace.export_chrome_trace(trace_path)
+        out["trace_artifact"] = trace_path
     out["ok"] = (
         violations == 0
         and zoned_placed == n_zoned        # selectors place AND respect

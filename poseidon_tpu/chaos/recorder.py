@@ -114,15 +114,23 @@ class FlightRecorder:
         metrics: dict,
         digest: str,
         placements: int,
+        spans: Optional[List[dict]] = None,
     ) -> None:
-        self.trace.rounds.append({
+        record = {
             "round": round_index,
             "faults": faults,
             "deltas": deltas,
             "metrics": metrics,
             "digest": digest,
             "placements": placements,
-        })
+        }
+        if spans:
+            # The round's obs.trace span window (telemetry payload, not
+            # replay input: redrive compares digests only).  Offline,
+            # ``replay/flight.flight_timeline`` lowers these back to a
+            # Perfetto-loadable Chrome trace of the failing round.
+            record["spans"] = spans
+        self.trace.rounds.append(record)
 
     def record_failure(self, round_index: int, kind: str,
                        error: str) -> str:

@@ -31,7 +31,6 @@ import hashlib
 import json
 import logging
 import time
-from dataclasses import asdict
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -39,6 +38,7 @@ import numpy as np
 from poseidon_tpu.chaos.inject import ChaoticKube, FaultInjector, chaotic_client
 from poseidon_tpu.chaos.plan import FaultPlan, named_plan
 from poseidon_tpu.chaos.recorder import FlightRecorder
+from poseidon_tpu.obs import trace as obs_trace
 
 log = logging.getLogger("poseidon.chaos.soak")
 
@@ -153,10 +153,10 @@ def _digest(view: Dict[str, str]) -> str:
 
 
 def _metrics_dict(metrics) -> dict:
-    d = asdict(metrics)
-    if d.get("gap_bound") == float("inf"):
-        d["gap_bound"] = "inf"
-    return d
+    # One wire format for a round's metrics everywhere (flight traces,
+    # bench sub-reports, the Prometheus exporter): the schema-versioned
+    # RoundMetrics.to_dict.
+    return metrics.to_dict()
 
 
 def _await(cond: Callable[[], bool], timeout: float) -> bool:
@@ -273,7 +273,19 @@ def run_soak(
     def _round_faults(r: int) -> List[dict]:
         return [e for e in injector.fired if e["round"] == r]
 
+    # Span recording rides every soak (forced on without touching the
+    # process environment): each round's spans — glue loop, round
+    # stages, RPC attempts, watcher events — are drained into that
+    # round's flight record, so a failing round's timeline re-renders
+    # offline (replay/flight.flight_timeline) from the trace alone.
+    # Forced only once inside the try so the finally's restore is
+    # guaranteed to run — a setup failure must not leak force=True into
+    # the rest of the process.
+    _tracer = obs_trace.tracer()
+    _prev_force = _tracer.force
     try:
+        _tracer.force = True
+        obs_trace.drain_spans()  # a clean window: drop pre-soak spans
         for node_i in range(machines):
             kube.add_node(Node(
                 name=f"m{node_i:04d}",
@@ -408,6 +420,7 @@ def run_soak(
                 metrics=metrics_d,
                 digest=digest,
                 placements=len(kube_truth),
+                spans=obs_trace.drain_spans(),
             )
             if kube_truth != sched_view:
                 only_kube = sorted(
@@ -462,6 +475,7 @@ def run_soak(
         log.error("soak failed (%s); flight trace: %s",
                   e, result["trace_path"])
     finally:
+        _tracer.force = _prev_force
         poseidon.stop()
         try:
             server.stop(grace=0.2)

@@ -146,11 +146,25 @@ class DeterminismRule(Rule):
     # chaos/ is in scope because fault plans MUST be seed-reproducible:
     # a soak whose faults fire off the wall clock or an OS-entropy RNG
     # cannot be re-driven from its flight trace, which voids the whole
-    # subsystem's replayability contract (docs/CHAOS.md).
+    # subsystem's replayability contract (docs/CHAOS.md).  obs/ is in
+    # scope with an extra confinement sub-check: the tracer
+    # (obs/trace.py) is the ONE module in the telemetry plane allowed
+    # to read a clock — everything else (metrics registry, exporters)
+    # must take durations from it, or metrics and timeline drift apart.
     scopes = (
         "poseidon_tpu/replay/", "poseidon_tpu/graph/", "poseidon_tpu/ops/",
-        "poseidon_tpu/chaos/",
+        "poseidon_tpu/chaos/", "poseidon_tpu/obs/",
     )
+
+    # Clock reads confined to obs/trace.py within obs/ (time.time is
+    # flagged everywhere in scope already; these are the non-wall clock
+    # reads the confinement additionally forbids outside the tracer).
+    _CLOCK_FNS = frozenset({
+        "perf_counter", "perf_counter_ns", "monotonic", "monotonic_ns",
+        "time_ns", "process_time", "process_time_ns",
+        "clock_gettime", "clock_gettime_ns",
+        "thread_time", "thread_time_ns",
+    })
 
     def check(self, tree: ast.AST, source: str, path: str) -> List[Finding]:
         time_aliases = import_aliases(tree, "time")
@@ -172,12 +186,29 @@ class DeterminismRule(Rule):
         def flag(node: ast.AST, message: str) -> None:
             findings.append(Finding(path, node.lineno, self.name, message))
 
+        norm_path = path.replace("\\", "/")
+        clock_confined = (
+            "poseidon_tpu/obs/" in norm_path
+            and not norm_path.endswith("poseidon_tpu/obs/trace.py")
+        )
+        clock_fns = (
+            {
+                local
+                for local, orig in from_imports(tree, "time").items()
+                if orig in self._CLOCK_FNS
+            }
+            if clock_confined else frozenset()
+        )
         for node in ast.walk(tree):
             if isinstance(node, ast.Call):
                 self._check_call(
                     node, flag, time_aliases, time_fns, random_aliases,
                     random_fns, np_aliases,
                 )
+                if clock_confined:
+                    self._check_clock_confinement(
+                        node, flag, time_aliases, clock_fns
+                    )
 
         # Set iteration: per-scope variable tracking, then flag iteration
         # sites.  Scopes: the module plus every function (nested included —
@@ -257,6 +288,21 @@ class DeterminismRule(Rule):
                            "value for the process (tests/bench setting "
                            "it later silently no-op); read at call time "
                            "or through an accessor")
+
+    # -- clock confinement (obs/ outside the tracer) -----------------------
+
+    def _check_clock_confinement(self, node, flag, time_aliases,
+                                 clock_fns) -> None:
+        fname = dotted_name(node.func)
+        if fname is None:
+            return
+        head, _, rest = fname.partition(".")
+        if (head in time_aliases and rest in self._CLOCK_FNS) or (
+            not rest and head in clock_fns
+        ):
+            flag(node, f"clock read `{fname}()` outside obs/trace.py; "
+                       "the tracer is the one clock owner in the "
+                       "telemetry plane — take durations from spans")
 
     # -- wall clock + RNG --------------------------------------------------
 

@@ -22,6 +22,8 @@ from typing import List
 from poseidon_tpu.glue.fake_kube import KubeAPI, Node
 from poseidon_tpu.glue.keyed_queue import KeyedQueue
 from poseidon_tpu.glue.types import SharedState
+from poseidon_tpu.obs import metrics as obs_metrics
+from poseidon_tpu.obs import trace as obs_trace
 from poseidon_tpu.protos import firmament_pb2 as fpb
 from poseidon_tpu.service.client import FirmamentClient
 from poseidon_tpu.utils.ids import resource_uuid
@@ -143,7 +145,10 @@ class NodeWatcher:
             key, items = batch
             try:
                 for kind, node in items:
-                    self._process(kind, node)
+                    with obs_trace.span("watch.node_event", kind=kind,
+                                        node=node.name):
+                        self._process(kind, node)
+                    obs_metrics.watch_event("node", kind)
             except Exception:
                 log.exception("node worker failed on %s", key)
             finally:

@@ -24,6 +24,8 @@ from typing import Dict, List
 from poseidon_tpu.glue.fake_kube import KubeAPI, Pod
 from poseidon_tpu.glue.keyed_queue import KeyedQueue
 from poseidon_tpu.glue.types import SharedState
+from poseidon_tpu.obs import metrics as obs_metrics
+from poseidon_tpu.obs import trace as obs_trace
 from poseidon_tpu.protos import firmament_pb2 as fpb
 from poseidon_tpu.service.client import FirmamentClient
 from poseidon_tpu.utils.ids import generate_uuid, task_uid
@@ -225,7 +227,10 @@ class PodWatcher:
             key, items = batch
             try:
                 for kind, pod in items:
-                    self._process(kind, pod)
+                    with obs_trace.span("watch.pod_event", kind=kind,
+                                        pod=pod.key):
+                        self._process(kind, pod)
+                    obs_metrics.watch_event("pod", kind)
             except Exception:
                 log.exception("pod worker failed on %s", key)
             finally:

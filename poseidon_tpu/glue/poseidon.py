@@ -28,6 +28,8 @@ from poseidon_tpu.glue.nodewatcher import NodeWatcher
 from poseidon_tpu.glue.podwatcher import PodWatcher
 from poseidon_tpu.glue.stats_server import StatsServer
 from poseidon_tpu.glue.types import SharedState
+from poseidon_tpu.obs import metrics as obs_metrics
+from poseidon_tpu.obs import trace as obs_trace
 from poseidon_tpu.protos import firmament_pb2 as fpb
 from poseidon_tpu.service.client import FirmamentClient, rpc_code
 from poseidon_tpu.utils.config import PoseidonConfig
@@ -62,6 +64,7 @@ class Poseidon:
         config: Optional[PoseidonConfig] = None,
         firmament: Optional[FirmamentClient] = None,
         stats_address: Optional[str] = None,
+        metrics_address: Optional[str] = None,
         run_loop: bool = True,
     ) -> None:
         # run_loop=False: callers drive rounds via schedule_once() — the
@@ -89,6 +92,15 @@ class Poseidon:
             self.stats_server = StatsServer(
                 self.shared, self.fc, address=stats_address
             )
+        # Prometheus exporter (obs/metrics.py): the scrape endpoint the
+        # deploy manifest annotates.  Explicit arg wins; else the config
+        # field (empty = disabled, the test-harness default).
+        self.metrics_server: Optional[obs_metrics.MetricsServer] = None
+        metrics_address = metrics_address or getattr(
+            self.config, "metrics_address", ""
+        ) or None
+        if metrics_address is not None:
+            self.metrics_server = obs_metrics.MetricsServer(metrics_address)
         self.loop_stats = LoopStats()
         self._stop = threading.Event()
         self._loop_thread: Optional[threading.Thread] = None
@@ -118,6 +130,9 @@ class Poseidon:
             raise RuntimeError("firmament service never became healthy")
         if self.stats_server is not None:
             self.stats_server.start()
+        if self.metrics_server is not None:
+            self.metrics_server.start()
+            log.info("metrics server on %s", self.metrics_server.address)
         self.node_watcher.run()
         # Initial node sync before pods start flowing (the informer
         # cache-sync ordering): a re-listed bound pod resolves its node's
@@ -139,6 +154,8 @@ class Poseidon:
         self.node_watcher.stop()
         if self.stats_server is not None:
             self.stats_server.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
         if self._loop_thread is not None:
             self._loop_thread.join(timeout=5.0)
 
@@ -169,7 +186,9 @@ class Poseidon:
         so the soak harness drives the exact production failure policy
         without a thread."""
         try:
-            self.schedule_once()
+            with obs_trace.span("glue.try_round") as sp:
+                self.schedule_once()
+                sp.set(deltas=len(self.last_deltas))
         except Exception:
             self.loop_stats.failed_rounds += 1
             self.loop_stats.consecutive_failures += 1
@@ -186,15 +205,32 @@ class Poseidon:
                 )
                 log.error("%s", self.fatal)
                 self._stop.set()
+                self._observe_metrics()
                 return None
             backoff = min(
                 self.config.crash_backoff_s * (2 ** (n - 1)),
                 self.config.crash_backoff_max_s,
             )
+            self._observe_metrics()
             # Full jitter on [backoff/2, backoff].
             return backoff * (0.5 + 0.5 * self._backoff_jitter.random())
         self.loop_stats.consecutive_failures = 0
+        self._observe_metrics()
         return self.config.scheduling_interval
+
+    def _observe_metrics(self) -> None:
+        """Refresh the Prometheus registry from the loop's state (every
+        round outcome, success or failure — the exporter thread only
+        reads)."""
+        obs_metrics.observe_loop(
+            self.loop_stats,
+            resyncs=(
+                self.pod_watcher.resyncs + self.node_watcher.resyncs
+            ),
+            crash_loop_budget=self.config.crash_loop_budget,
+            fatal=self.fatal is not None,
+        )
+        obs_metrics.observe_ledger()
 
     def schedule_once(self) -> List[fpb.SchedulingDelta]:
         """One Schedule() call + transactional delta enactment
@@ -208,9 +244,11 @@ class Poseidon:
         Unknown ids stay fatal (poseidon.go:43) — they mean the id maps
         themselves are broken, which no retry fixes."""
         self.last_deltas = []
-        self._flush_resubmits()
+        with obs_trace.span("glue.flush_resubmits"):
+            self._flush_resubmits()
         try:
-            deltas = self.fc.schedule()
+            with obs_trace.span("glue.schedule_rpc"):
+                deltas = self.fc.schedule()
         except Exception as e:
             # Commit-ambiguity is code-aware: UNAVAILABLE means the
             # request was never processed (and the client already
@@ -237,7 +275,8 @@ class Poseidon:
         suspect = self._schedule_suspect
         delta_uids = set()
         try:
-            self._enact(deltas, delta_uids)
+            with obs_trace.span("glue.enact", deltas=len(deltas)):
+                self._enact(deltas, delta_uids)
         except Exception:
             # A mid-enactment abort orphans this round's remaining
             # committed deltas — the same phantom shape as a lost
@@ -246,7 +285,8 @@ class Poseidon:
             self._schedule_suspect = True
             raise
         if suspect:
-            self._reconcile_after_failure(delta_uids)
+            with obs_trace.span("glue.reconcile"):
+                self._reconcile_after_failure(delta_uids)
         # Lifecycle GC: placements whose tasks finished or left the
         # cluster (the pod watcher owns those transitions) must leave
         # the enacted map, or it grows one entry per pod ever placed.

@@ -24,7 +24,7 @@ import enum
 import logging
 import os
 import time
-from dataclasses import dataclass, field
+from dataclasses import asdict, dataclass, field, fields
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -38,6 +38,7 @@ from poseidon_tpu.ops.transport import (
     solve_transport,
     sparse_adm_cells,
 )
+from poseidon_tpu.obs import trace as _trace
 from poseidon_tpu.utils.stagetimer import stage as _stage
 
 
@@ -109,6 +110,41 @@ class RoundMetrics:
     # cold retry (gap_bound is then inf and the committed placement is the
     # repaired feasible-but-suboptimal one).  Alarmed via log.error.
     converged: bool = True
+
+    # Serialization schema version: bumped whenever a field is renamed
+    # or its meaning changes (pure additions keep the version — from_dict
+    # defaults missing fields and drops unknown ones).
+    SCHEMA = 1
+
+    def to_dict(self) -> dict:
+        """THE round-metrics wire format: JSON-safe, schema-versioned.
+
+        Single source of truth for every serialization of a round —
+        chaos soak round records (``chaos/soak.py``), bench sub-reports,
+        and the Prometheus exporter (``obs/metrics.observe_round``) all
+        consume this dict, so a new RoundMetrics field lands in all
+        three without touching them."""
+        d = asdict(self)
+        if d["gap_bound"] == float("inf"):
+            d["gap_bound"] = "inf"  # json has no Infinity literal
+        d["schema"] = self.SCHEMA
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RoundMetrics":
+        """Inverse of ``to_dict``; tolerant of unknown keys (forward
+        compat) and missing ones (dataclass defaults apply)."""
+        d = dict(d)
+        schema = int(d.pop("schema", cls.SCHEMA))
+        if schema > cls.SCHEMA:
+            raise ValueError(
+                f"RoundMetrics schema {schema} is newer than supported "
+                f"({cls.SCHEMA})"
+            )
+        if d.get("gap_bound") == "inf":
+            d["gap_bound"] = float("inf")
+        known = {f.name for f in fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
 
 
 @dataclass
@@ -622,6 +658,34 @@ class RoundPlanner:
     # ------------------------------------------------------------------ round
 
     def schedule_round(self) -> Tuple[List[Delta], RoundMetrics]:
+        """One round under a ``round`` tracer span: the span parents the
+        stage spans opened beneath it (``round.view_build`` ...
+        ``round.assign``, the ``solve.*`` stages) and carries the
+        round's headline attributes, so an exported Perfetto timeline
+        decomposes the round without consulting the metrics stream."""
+        with _trace.span("round") as sp:
+            deltas, metrics = self._schedule_round()
+            sp.set(
+                round=metrics.round_index,
+                solve_tier=metrics.solve_tier,
+                tasks=metrics.num_tasks,
+                ecs=metrics.num_ecs,
+                machines=metrics.num_machines,
+                placed=metrics.placed,
+                unscheduled=metrics.unscheduled,
+                iterations=metrics.iterations,
+                device_calls=metrics.device_calls,
+                fresh_compiles=metrics.fresh_compiles,
+                repair_firings=metrics.repair_firings,
+                pruned_bands=metrics.pruned_bands,
+                pruned_width=metrics.pruned_width,
+                pruned_price_out_rounds=metrics.pruned_price_out_rounds,
+                pruned_escalations=metrics.pruned_escalations,
+                converged=metrics.converged,
+            )
+        return deltas, metrics
+
+    def _schedule_round(self) -> Tuple[List[Delta], RoundMetrics]:
         t0 = time.perf_counter()
         st = self.state
 

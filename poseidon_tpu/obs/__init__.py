@@ -1,0 +1,31 @@
+"""poseidon_tpu.obs — the scheduler's own telemetry plane.
+
+The reference system ships a whole external telemetry stack (Heapster
+sink -> PoseidonStats gRPC -> Firmament knowledge base) for *workload*
+stats, but has no self-telemetry: nothing tells you where a Schedule()
+round's time went.  Every perf round so far (PR 2-4) started by
+discovering that the bottleneck was NOT where the coarse metrics said it
+was — hidden XLA compiles inside "solve time", host rebuilds inside
+"mask time", poisoned warm starts billed to the solver.
+
+This package is the in-process instrumentation that makes those
+invisible costs first-class:
+
+- ``obs.trace``   — a thread-safe hierarchical span tracer over the
+  round pipeline (glue loop, round stages, solver stages, RPC attempts)
+  with Chrome-trace-event JSON export loadable in Perfetto, and a
+  zero-overhead disabled path;
+- ``obs.metrics`` — a Prometheus-style metrics registry
+  (counters/gauges/histograms with text exposition served over HTTP),
+  auto-fed from ``RoundMetrics``, the glue ``LoopStats``, the client's
+  retry machinery, and the compile ledger.
+
+``utils.stagetimer`` is now a thin compatibility shim over the tracer
+(same ``snapshot()/report()`` API, same ``POSEIDON_STAGE_TIMERS=1``
+gate); ``tools/bench_compare.py`` + ``make perf-gate`` turn the exported
+per-stage timings into a perf-regression gate.
+"""
+
+from poseidon_tpu.obs import metrics, trace
+
+__all__ = ["metrics", "trace"]

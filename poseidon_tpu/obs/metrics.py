@@ -1,0 +1,531 @@
+"""Metrics registry + Prometheus text exposition + tiny HTTP exporter.
+
+The deploy manifests' scrape story finally has a server behind it: a
+process-wide :class:`Registry` of counters/gauges/histograms, rendered
+in the Prometheus text exposition format (version 0.0.4) and served by
+:class:`MetricsServer` — a stdlib ``ThreadingHTTPServer`` on its own
+daemon thread (``/metrics`` + ``/healthz``), no dependencies.
+
+Feeding is schema-driven, not hand-enumerated: ``observe_round`` walks
+``RoundMetrics.to_dict()`` (the single schema-versioned round-metrics
+serialization) so every field — present and future — lands as a
+``poseidon_round_*`` gauge, with the monotonic per-round counts also
+accumulated into ``poseidon_rounds_*_total`` counters and the two
+latency fields into histograms.  ``observe_loop`` mirrors the glue
+``LoopStats`` + watcher resyncs; the client's retry machinery calls
+``rpc_attempt``/``rpc_error`` per attempt; ``observe_ledger`` exposes
+the process-wide compile-ledger counters when jax is already loaded
+(it never *imports* jax into a glue-only process).
+
+Thread safety: one registry lock for child creation, one lock per
+metric child for updates — the hot paths (a counter bump per RPC) stay
+a dict probe + locked float add.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# Default latency buckets (seconds): sub-ms watch events up through the
+# multi-minute cold-compile rounds the TPU sessions recorded.
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+
+def _escape_label(value: str) -> str:
+    return (
+        value.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
+    )
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    if v != v:  # NaN
+        return "NaN"
+    if float(v).is_integer() and abs(v) < 2**53:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _labels_text(names: Sequence[str], values: Sequence[str]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(
+        f'{n}="{_escape_label(str(v))}"' for n, v in zip(names, values)
+    )
+    return "{" + inner + "}"
+
+
+class _Child:
+    """One labelset's state; updates locked per child."""
+
+    __slots__ = ("lock", "value", "bucket_counts", "sum", "count")
+
+    def __init__(self, buckets: Optional[Tuple[float, ...]] = None) -> None:
+        self.lock = threading.Lock()
+        self.value = 0.0
+        if buckets is not None:
+            self.bucket_counts = [0] * (len(buckets) + 1)  # + +Inf
+            self.sum = 0.0
+            self.count = 0
+
+
+class Metric:
+    """Base: a named family of children keyed by label values."""
+
+    type_name = "untyped"
+
+    def __init__(self, name: str, help: str,  # noqa: A002 - prom term
+                 labelnames: Sequence[str] = ()) -> None:
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        for ln in labelnames:
+            if not _LABEL_RE.match(ln) or ln.startswith("__"):
+                raise ValueError(f"invalid label name {ln!r}")
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], _Child] = {}
+        if not self.labelnames:
+            self._children[()] = self._new_child()
+
+    def _new_child(self) -> _Child:
+        return _Child()
+
+    def labels(self, *values) -> _Child:
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected {len(self.labelnames)} label "
+                f"values, got {len(values)}"
+            )
+        key = tuple(str(v) for v in values)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(key, self._new_child())
+        return child
+
+    def labelsets(self) -> List[Tuple[str, ...]]:
+        """Every labelset this family has exported so far."""
+        with self._lock:
+            return list(self._children)
+
+    def _samples(self) -> Iterable[Tuple[str, str, float]]:
+        """(suffix, rendered-labels, value) triples, label-sorted."""
+        with self._lock:
+            items = sorted(self._children.items())
+        for key, child in items:
+            with child.lock:
+                yield "", _labels_text(self.labelnames, key), child.value
+
+    def expose(self) -> str:
+        lines = [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} {self.type_name}",
+        ]
+        for suffix, labels, value in self._samples():
+            lines.append(f"{self.name}{suffix}{labels} {_fmt_value(value)}")
+        return "\n".join(lines)
+
+
+class Counter(Metric):
+    type_name = "counter"
+
+    def inc(self, amount: float = 1.0, *labelvalues) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        child = self.labels(*labelvalues)
+        with child.lock:
+            child.value += amount
+
+    def set_total(self, total: float, *labelvalues) -> None:
+        """Pin the cumulative value from an external monotonic source
+        (LoopStats counters, the compile ledger) that owns monotonicity.
+        Regressions are clamped — exposition must never go backwards."""
+        child = self.labels(*labelvalues)
+        with child.lock:
+            if total > child.value:
+                child.value = float(total)
+
+    def value(self, *labelvalues) -> float:
+        child = self.labels(*labelvalues)
+        with child.lock:
+            return child.value
+
+
+class Gauge(Metric):
+    type_name = "gauge"
+
+    def set(self, value: float, *labelvalues) -> None:
+        child = self.labels(*labelvalues)
+        with child.lock:
+            child.value = float(value)
+
+    def inc(self, amount: float = 1.0, *labelvalues) -> None:
+        child = self.labels(*labelvalues)
+        with child.lock:
+            child.value += amount
+
+    def value(self, *labelvalues) -> float:
+        child = self.labels(*labelvalues)
+        with child.lock:
+            return child.value
+
+
+class Histogram(Metric):
+    type_name = "histogram"
+
+    def __init__(self, name: str, help: str,  # noqa: A002
+                 labelnames: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS) -> None:
+        bs = tuple(sorted(float(b) for b in buckets))
+        if not bs:
+            raise ValueError("histogram needs at least one bucket")
+        self.buckets = bs
+        super().__init__(name, help, labelnames)
+
+    def _new_child(self) -> _Child:
+        return _Child(buckets=self.buckets)
+
+    def observe(self, value: float, *labelvalues) -> None:
+        child = self.labels(*labelvalues)
+        with child.lock:
+            child.sum += value
+            child.count += 1
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    child.bucket_counts[i] += 1
+                    break
+            else:
+                child.bucket_counts[-1] += 1
+
+    def _samples(self) -> Iterable[Tuple[str, str, float]]:
+        with self._lock:
+            items = sorted(self._children.items())
+        for key, child in items:
+            with child.lock:
+                counts = list(child.bucket_counts)
+                total = child.count
+                ssum = child.sum
+            cumulative = 0
+            for ub, n in zip(self.buckets, counts):
+                cumulative += n
+                labels = _labels_text(
+                    self.labelnames + ("le",), key + (_fmt_value(ub),)
+                )
+                yield "_bucket", labels, float(cumulative)
+            labels = _labels_text(self.labelnames + ("le",), key + ("+Inf",))
+            yield "_bucket", labels, float(total)
+            yield "_sum", _labels_text(self.labelnames, key), ssum
+            yield "_count", _labels_text(self.labelnames, key), float(total)
+
+
+class Registry:
+    """Named metric families; get-or-create with type/label checking."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str,  # noqa: A002
+                       labelnames: Sequence[str], **kw) -> Metric:
+        # Lock-free fast path: dict reads are atomic under the GIL and
+        # families are never removed, so the hot feeds (every watch
+        # event, every RPC attempt) resolve without contending on the
+        # registry lock — it is taken only to create a family.
+        existing = self._metrics.get(name)
+        if existing is None:
+            with self._lock:
+                existing = self._metrics.get(name)
+                if existing is None:
+                    metric = cls(name, help, labelnames, **kw)
+                    self._metrics[name] = metric
+                    return metric
+        if not isinstance(existing, cls) or \
+                existing.labelnames != tuple(labelnames):
+            raise ValueError(
+                f"metric {name!r} re-registered with a different "
+                f"type/labelset"
+            )
+        return existing
+
+    def counter(self, name: str, help: str = "",  # noqa: A002
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",  # noqa: A002
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",  # noqa: A002
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(
+            Histogram, name, help, labelnames, buckets=buckets
+        )
+
+    def expose(self) -> str:
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        return "\n".join(m.expose() for m in metrics) + "\n"
+
+
+_REGISTRY = Registry()
+
+
+def default_registry() -> Registry:
+    return _REGISTRY
+
+
+# ----------------------------------------------------------------- exporter
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry: Registry = _REGISTRY
+
+    def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = self.registry.expose().encode("utf-8")
+            ctype = CONTENT_TYPE
+        elif path in ("/", "/healthz"):
+            body = b"ok\n"
+            ctype = "text/plain; charset=utf-8"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args) -> None:  # scrapes are not log news
+        pass
+
+
+class MetricsServer:
+    """`/metrics` on a daemon thread (the Poseidon process's scrape
+    endpoint; deploy/poseidon-deployment.yaml annotates the port)."""
+
+    def __init__(self, address: str = "0.0.0.0:9100",
+                 registry: Optional[Registry] = None) -> None:
+        # Bind happens in start(), not here: an instance whose owner
+        # fails before start() (e.g. Poseidon.start raising on an
+        # unhealthy service) must not hold the port hostage until GC.
+        host, _, port = address.rpartition(":")
+        self._bind = (host or "0.0.0.0", int(port))
+        self._handler = type(
+            "_BoundHandler", (_Handler,),
+            {"registry": registry or _REGISTRY},
+        )
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+        self.address: Optional[str] = None
+
+    def start(self) -> "MetricsServer":
+        self._httpd = ThreadingHTTPServer(self._bind, self._handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        host = self._bind[0]
+        if host in ("0.0.0.0", "::", ""):
+            host = "127.0.0.1"
+        self.address = f"{host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="metrics-server",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:  # never started
+            return
+        if self._thread is not None:
+            # shutdown() blocks until serve_forever exits — only safe
+            # when the serving thread actually ran.
+            self._httpd.shutdown()
+        self._httpd.server_close()
+        self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+# ------------------------------------------------------------------- feeds
+
+# The degraded-ladder vocabulary (graph/instance.py RoundMetrics
+# .solve_tier): exported one-hot so dashboards can plot tier occupancy.
+SOLVE_TIERS = ("none", "quiet", "pruned", "dense", "host_greedy")
+
+# RoundMetrics fields that are per-round event counts: also accumulated
+# into process-lifetime counters next to the per-round gauges.
+_ROUND_COUNTERS = (
+    "placed", "preempted", "migrated", "device_calls",
+    "fresh_compiles", "iterations", "bf_sweeps", "repair_firings",
+)
+
+
+def observe_round(metrics, registry: Optional[Registry] = None) -> None:
+    """Feed one round's ``RoundMetrics`` (the object or its
+    ``to_dict()``) into the registry.  Schema-driven: every numeric
+    field becomes a ``poseidon_round_<field>`` gauge, so a field added
+    to RoundMetrics is exported without touching this module."""
+    reg = registry or _REGISTRY
+    d = metrics.to_dict() if hasattr(metrics, "to_dict") else dict(metrics)
+    d.pop("schema", None)
+    tier = d.pop("solve_tier", "none")
+    tier_g = reg.gauge(
+        "poseidon_round_solve_tier",
+        "Which degraded-ladder tier served the last round (one-hot)",
+        ("tier",),
+    )
+    # Zero every labelset ever exported (not just SOLVE_TIERS: a tier
+    # name added to instance.py before this list is updated must not
+    # stay pinned at 1 forever), then mark the serving tier.
+    for key in tier_g.labelsets():
+        tier_g.set(0.0, *key)
+    for t in SOLVE_TIERS:
+        if t != tier:
+            tier_g.set(0.0, t)
+    tier_g.set(1.0, tier)
+    for key in sorted(d):
+        val = d[key]
+        if val == "inf":
+            val = float("inf")
+        if isinstance(val, bool):
+            val = float(val)
+        if not isinstance(val, (int, float)):
+            continue
+        reg.gauge(
+            f"poseidon_round_{key}",
+            f"RoundMetrics.{key} of the most recent schedule round",
+        ).set(float(val))
+        if key in _ROUND_COUNTERS:
+            reg.counter(
+                f"poseidon_rounds_{key}_total",
+                f"RoundMetrics.{key} accumulated across rounds",
+            ).inc(max(float(val), 0.0))
+    reg.counter(
+        "poseidon_rounds_observed_total", "Schedule rounds observed"
+    ).inc()
+    # Histogram names must not collide with the schema-walked
+    # ``poseidon_round_<field>`` gauges (solve_seconds is a field).
+    reg.histogram(
+        "poseidon_round_duration_seconds", "End-to-end schedule round latency"
+    ).observe(float(d.get("total_seconds", 0.0)))
+    reg.histogram(
+        "poseidon_round_solve_duration_seconds", "Solver window of the round"
+    ).observe(float(d.get("solve_seconds", 0.0)))
+
+
+def observe_loop(stats, *, resyncs: int = 0, crash_loop_budget: int = 0,
+                 fatal: bool = False,
+                 registry: Optional[Registry] = None) -> None:
+    """Feed the glue loop's ``LoopStats`` + watcher resync counts.
+    Cumulative LoopStats fields pin counters via ``set_total`` (the
+    dataclass owns monotonicity); instantaneous ones are gauges."""
+    reg = registry or _REGISTRY
+    for field in ("rounds", "placed", "preempted", "migrated",
+                  "failed_rounds", "bind_failures", "requeued"):
+        reg.counter(
+            f"poseidon_loop_{field}_total",
+            f"LoopStats.{field} (glue schedule loop)",
+        ).set_total(float(getattr(stats, field)))
+    reg.counter(
+        "poseidon_watch_resyncs_total",
+        "Pod+node watch resyncs after dropped watches",
+    ).set_total(float(resyncs))
+    reg.gauge(
+        "poseidon_loop_consecutive_failures",
+        "Consecutive failed rounds (crash-loop budget numerator)",
+    ).set(float(stats.consecutive_failures))
+    reg.gauge(
+        "poseidon_crash_loop_budget",
+        "Configured consecutive-failure budget before fatal stop",
+    ).set(float(crash_loop_budget))
+    reg.gauge(
+        "poseidon_loop_fatal",
+        "1 once the crash-loop budget stopped the schedule loop",
+    ).set(1.0 if fatal else 0.0)
+
+
+def observe_ledger(registry: Optional[Registry] = None) -> None:
+    """Expose the compile ledger's process-wide counters.  Reads them
+    only when jax is already imported: the glue process must not pay a
+    jax import for two series that would read 0 anyway."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return
+    from poseidon_tpu.check.ledger import fresh_compile_count, retrace_count
+
+    reg = registry or _REGISTRY
+    reg.counter(
+        "poseidon_fresh_compiles_total",
+        "Process-wide fresh XLA backend compiles (check/ledger.py)",
+    ).set_total(float(fresh_compile_count()))
+    reg.counter(
+        "poseidon_retraces_total",
+        "Process-wide jaxpr traces (compile-cache-hit retraces included)",
+    ).set_total(float(retrace_count()))
+
+
+def rpc_attempt(rpc: str, registry: Optional[Registry] = None) -> None:
+    reg = registry or _REGISTRY
+    reg.counter(
+        "poseidon_client_rpc_attempts_total",
+        "Firmament client RPC attempts (retries counted individually)",
+        ("rpc",),
+    ).inc(1.0, rpc)
+
+
+def rpc_error(rpc: str, code: str, retried: bool,
+              registry: Optional[Registry] = None) -> None:
+    reg = registry or _REGISTRY
+    reg.counter(
+        "poseidon_client_rpc_errors_total",
+        "Firmament client RPC failures by status code",
+        ("rpc", "code"),
+    ).inc(1.0, rpc, code)
+    if retried:
+        reg.counter(
+            "poseidon_client_rpc_retries_total",
+            "Failed attempts absorbed by the client's bounded retry",
+            ("rpc",),
+        ).inc(1.0, rpc)
+    if code == "DEADLINE_EXCEEDED":
+        reg.counter(
+            "poseidon_client_rpc_deadline_total",
+            "RPC attempts that hit their per-RPC deadline",
+            ("rpc",),
+        ).inc(1.0, rpc)
+
+
+def watch_event(watcher: str, kind: str,
+                registry: Optional[Registry] = None) -> None:
+    reg = registry or _REGISTRY
+    reg.counter(
+        "poseidon_watch_events_total",
+        "Watch events processed by the pod/node watchers",
+        ("watcher", "kind"),
+    ).inc(1.0, watcher, kind)

@@ -13,12 +13,18 @@ A soak failure (poseidon_tpu/chaos) leaves a ``FlightTrace`` JSON under
 - ``flight_trace_events(path)`` lowers the workload onto the replay
   harness's ``TraceEvent`` vocabulary for planner-only analysis
   (``ReplayDriver`` accepts the result directly — no glue stack, no
-  faults, just the population).
+  faults, just the population);
+- ``flight_timeline(path)`` re-renders a recorded round's span window
+  (the obs.trace spans the soak drained into each round record) as
+  Chrome trace-event JSON — the failing round's Perfetto timeline,
+  reconstructed offline from the trace alone.
 """
 
 from __future__ import annotations
 
-from typing import List
+import json
+import os
+from typing import List, Optional
 
 from poseidon_tpu.replay.trace import TraceEvent
 
@@ -33,6 +39,58 @@ def load_flight(path: str):
 def flight_trace_events(path: str) -> List[TraceEvent]:
     """The trace's workload as replay TraceEvents."""
     return load_flight(path).to_trace_events()
+
+
+def flight_timeline(path: str, round_index: Optional[int] = None,
+                    out_path: Optional[str] = None) -> dict:
+    """Re-render a recorded round's span timeline from a flight trace.
+
+    ``round_index`` defaults to the recorded failing round (falling back
+    to the last recorded round — a soak that failed before its first
+    record has no timeline to render, which raises).  Returns the
+    Chrome trace-event JSON object (``obs.trace.chrome_trace``); with
+    ``out_path`` it is also written to disk, ready for
+    https://ui.perfetto.dev."""
+    from poseidon_tpu.obs.trace import chrome_trace
+
+    trace = load_flight(path)
+    explicit = round_index is not None
+    if round_index is None:
+        failure = trace.failure or {}
+        round_index = int(failure.get("round", len(trace.rounds) - 1))
+    by_round = {int(r["round"]): r for r in trace.rounds}
+    record = by_round.get(round_index)
+    if record is None and explicit:
+        # An explicitly requested round must exist: silently rendering
+        # a different round would have the caller debugging the wrong
+        # timeline.  The fallback below is for the DEFAULT path only.
+        raise ValueError(
+            f"{path}: round {round_index} has no recorded span window "
+            f"(recorded rounds: {sorted(by_round)})"
+        )
+    if record is None and trace.rounds:
+        # The failing round often never completed (its record is the
+        # failure itself): the last COMPLETED round's timeline is the
+        # closest recorded view of the run's final state.
+        record = trace.rounds[-1]
+        round_index = int(record["round"])
+    if record is None:
+        raise ValueError(f"{path}: no recorded rounds to render")
+    spans = record.get("spans") or []
+    obj = chrome_trace(spans)
+    obj["flightMeta"] = {
+        "trace": os.path.basename(path),
+        "round": round_index,
+        "spans": len(spans),
+    }
+    if out_path is not None:
+        d = os.path.dirname(out_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(out_path, "w", encoding="utf-8") as fh:
+            json.dump(obj, fh)
+            fh.write("\n")
+    return obj
 
 
 def redrive_flight(path: str) -> dict:

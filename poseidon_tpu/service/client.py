@@ -29,6 +29,8 @@ from typing import FrozenSet, List, Optional
 
 import grpc
 
+from poseidon_tpu.obs import metrics as obs_metrics
+from poseidon_tpu.obs import trace as obs_trace
 from poseidon_tpu.protos import firmament_pb2 as fpb
 from poseidon_tpu.protos.services import (
     FIRMAMENT_METHODS,
@@ -146,23 +148,39 @@ class FirmamentClient:
         stub = getattr(self._stubs, rpc)
         attempt = 0
         while True:
-            try:
-                response = stub(request, timeout=self.rpc_timeout_s or None)
-                if attempts_out is not None:
-                    attempts_out.append(attempt)
-                return response
-            except grpc.RpcError as e:
-                if attempt >= self.rpc_retries or \
-                        rpc_code(e) not in retry_codes:
-                    raise
-                delay = min(
-                    self.rpc_backoff_s * (2 ** attempt),
-                    self.rpc_backoff_max_s,
-                )
-                # Full jitter on [delay/2, delay]: decorrelates a fleet
-                # of clients hammering a recovering service.
-                time.sleep(delay * (0.5 + 0.5 * self._jitter.random()))
-                attempt += 1
+            # One span per ATTEMPT (not per logical call): a retried RPC
+            # shows as adjacent spans whose code/backoff attributes
+            # reconstruct the retry ladder on the timeline.
+            with obs_trace.span(f"rpc.{rpc}", attempt=attempt) as sp:
+                obs_metrics.rpc_attempt(rpc)
+                try:
+                    response = stub(
+                        request, timeout=self.rpc_timeout_s or None
+                    )
+                    if attempts_out is not None:
+                        attempts_out.append(attempt)
+                    return response
+                except grpc.RpcError as e:
+                    code = rpc_code(e)
+                    code_name = code.name if code is not None else "UNKNOWN"
+                    retrying = (
+                        attempt < self.rpc_retries and code in retry_codes
+                    )
+                    sp.set(code=code_name, retrying=retrying)
+                    obs_metrics.rpc_error(rpc, code_name, retried=retrying)
+                    if not retrying:
+                        raise
+                    delay = min(
+                        self.rpc_backoff_s * (2 ** attempt),
+                        self.rpc_backoff_max_s,
+                    )
+                    sp.set(backoff_s=round(delay, 4))
+            # Full jitter on [delay/2, delay]: decorrelates a fleet
+            # of clients hammering a recovering service.  (The sleep
+            # sits OUTSIDE the attempt span: backoff is idle time, not
+            # RPC time.)
+            time.sleep(delay * (0.5 + 0.5 * self._jitter.random()))
+            attempt += 1
 
     # ------------------------------------------------------------------ RPCs
 

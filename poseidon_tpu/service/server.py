@@ -25,6 +25,7 @@ import grpc
 from poseidon_tpu.costmodel import get_cost_model
 from poseidon_tpu.graph.instance import RoundPlanner
 from poseidon_tpu.graph.state import ClusterState
+from poseidon_tpu.obs import metrics as obs_metrics
 from poseidon_tpu.protos import firmament_pb2 as fpb
 from poseidon_tpu.protos.services import (
     FIRMAMENT_METHODS,
@@ -141,6 +142,10 @@ class FirmamentServicer:
             metrics.total_seconds, metrics.objective,
             metrics.iterations, metrics.bf_sweeps, metrics.device_calls,
         )
+        # Prometheus feed: every RoundMetrics field (schema-driven via
+        # to_dict) plus the process-wide compile-ledger counters.
+        obs_metrics.observe_round(metrics)
+        obs_metrics.observe_ledger()
         every = self.config.checkpoint_every_rounds
         if (
             self.config.checkpoint_path and every > 0
@@ -280,6 +285,15 @@ class FirmamentTPUServer:
             raise RuntimeError(
                 f"could not bind {self.config.listen_address}"
             )
+        # Service-side Prometheus exporter: the round metrics and the
+        # compile ledger live in THIS process (Schedule() runs here),
+        # so without an endpoint of its own every poseidon_round_*
+        # series would be unscrapable in the deployed two-pod topology.
+        self.metrics_server: Optional[obs_metrics.MetricsServer] = None
+        if self.config.metrics_address:
+            self.metrics_server = obs_metrics.MetricsServer(
+                self.config.metrics_address
+            )
 
     @property
     def address(self) -> str:
@@ -290,10 +304,16 @@ class FirmamentTPUServer:
 
     def start(self) -> "FirmamentTPUServer":
         self._server.start()
+        if self.metrics_server is not None:
+            self.metrics_server.start()
+            log.info("metrics on http://%s/metrics",
+                     self.metrics_server.address)
         log.info("firmament-tpu serving on %s", self.address)
         return self
 
     def stop(self, grace: Optional[float] = None) -> None:
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
         self._server.stop(grace).wait()
 
     def wait(self) -> None:

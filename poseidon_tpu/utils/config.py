@@ -28,6 +28,10 @@ class PoseidonConfig:
     kube_config: str = ""
     kube_version: str = "1.6"
     stats_server_address: str = "0.0.0.0:9091"
+    # Prometheus exposition endpoint (obs/metrics.MetricsServer): the
+    # port deploy/poseidon-deployment.yaml annotates for scraping.
+    # Empty disables the exporter (the test-harness default).
+    metrics_address: str = ""
     scheduling_interval: float = 10.0  # seconds; config.go:120
     # RPC hardening (the reference has none of these: its client blocks
     # forever on a wedged Firmament): per-RPC deadline, bounded retry
@@ -62,6 +66,12 @@ class FirmamentTPUConfig:
     deploy/firmament-deployment.yaml:29)."""
 
     listen_address: str = "0.0.0.0:9090"
+    # Prometheus exposition endpoint (obs/metrics.MetricsServer) for the
+    # SERVICE process: the round-metrics and compile-ledger series are
+    # fed here (the round runs in this process, not in glue), so the
+    # deployed scrape story needs an exporter on both pods.  Empty
+    # disables it (the test-harness default).
+    metrics_address: str = ""
     # Cost model selection; "cpu_mem" reproduces the reference's active model
     # (README.md:57-59).  Others: "trivial", "net", "coco", "whare".
     cost_model: str = "cpu_mem"

@@ -1,53 +1,53 @@
-"""Per-stage wall timers for the schedule round, enabled by
-``POSEIDON_STAGE_TIMERS=1`` (zero overhead otherwise: the context
-manager short-circuits).
+"""Per-stage wall timers for the schedule round — now a thin shim over
+the ``poseidon_tpu.obs.trace`` span tracer.
 
-Why: the tunneled accelerator's wave budget splits between host prep
-(cost build, greedy starts, epsilon derivation), per-transfer tunnel
-latency (~60-150 ms per direction, measured 2026-07-31 live session),
-in-program device time, and host assignment/commit — and the winning
-optimization differs for each.  ``tools/profile_wave.py`` reads the
-accumulated table after driving waves against the real backend.
+The original implementation accumulated into process-global dicts with
+no lock: two concurrent rounds (the soak harness, the overlapped-assign
+worker threads) raced ``_totals[name] += dt`` and silently lost time.
+The tracer owns accumulation now — locked, thread-safe, and shared with
+the span timeline, so ``snapshot()`` totals and an exported Perfetto
+trace are two views of the SAME records and cannot drift apart.
+
+The public API is unchanged (``stage``/``snapshot``/``report``/
+``reset``, gated by ``POSEIDON_STAGE_TIMERS=1`` with a zero-overhead
+disabled path), so ``tools/profile_wave.py``, ``bench.py``, and every
+``with stage("round.x"):`` call site keep working verbatim.  With
+``POSEIDON_TRACE=1`` the same call sites additionally record full spans
+(see docs/OBSERVABILITY.md); ``reset()`` clears the aggregate table
+only, leaving any recorded spans for export.
+
+Why (unchanged): the tunneled accelerator's wave budget splits between
+host prep (cost build, greedy starts, epsilon derivation), per-transfer
+tunnel latency (~60-150 ms per direction, measured 2026-07-31 live
+session), in-program device time, and host assignment/commit — and the
+winning optimization differs for each.
 """
 
 from __future__ import annotations
 
-import contextlib
 import os
-import time
-from collections import defaultdict
 from typing import Dict, Tuple
 
-_totals: Dict[str, float] = defaultdict(float)
-_counts: Dict[str, int] = defaultdict(int)
+from poseidon_tpu.obs import trace as _trace
 
 
 def enabled() -> bool:
     return os.environ.get("POSEIDON_STAGE_TIMERS") == "1"
 
 
-@contextlib.contextmanager
 def stage(name: str):
-    if not enabled():
-        yield
-        return
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        dt = time.perf_counter() - t0
-        _totals[name] += dt
-        _counts[name] += 1
+    """Context manager timing one stage (a tracer span; no-op unless
+    stage timers or tracing are enabled)."""
+    return _trace.span(name)
 
 
 def snapshot() -> Dict[str, Tuple[float, int]]:
     """{stage: (total_seconds, calls)} accumulated since last reset."""
-    return {k: (_totals[k], _counts[k]) for k in _totals}
+    return _trace.snapshot_totals()
 
 
 def reset() -> None:
-    _totals.clear()
-    _counts.clear()
+    _trace.reset_totals()
 
 
 def report() -> str:

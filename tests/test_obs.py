@@ -1,0 +1,655 @@
+"""obs/ telemetry plane: tracer, Perfetto export, Prometheus metrics,
+stagetimer shim parity, flight-timeline re-render, and the perf gate.
+
+The satellite contracts pinned here:
+
+- span/stagetimer total-time parity under CONCURRENT rounds (the
+  original stagetimer raced `_totals[name] += dt` and lost time);
+- Prometheus exposition conformance: label escaping, histogram bucket
+  monotonicity, TYPE/HELP discipline;
+- Perfetto export is valid trace-event JSON with properly nested
+  round -> stage spans;
+- `RoundMetrics.to_dict()` is THE round wire format and round-trips;
+- `tools/bench_compare.py` fails on a synthetically slowed stage and
+  never compares apples to oranges.
+"""
+
+from __future__ import annotations
+
+import ast
+import copy
+import json
+import re
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from poseidon_tpu.obs import metrics as obs_metrics
+from poseidon_tpu.obs import trace as obs_trace
+
+REPO = Path(__file__).parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import bench_compare  # noqa: E402  (tools/ is not a package)
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer(monkeypatch):
+    """Every test starts with a quiet, env-ungated process tracer."""
+    monkeypatch.delenv(obs_trace.TRACE_ENV, raising=False)
+    monkeypatch.delenv(obs_trace.STAGE_ENV, raising=False)
+    tracer = obs_trace.tracer()
+    prev_force = tracer.force
+    tracer.force = None
+    tracer.reset()
+    yield
+    tracer.force = prev_force
+    tracer.reset()
+
+
+# ------------------------------------------------------------------ tracer
+
+
+def test_disabled_path_is_shared_noop_singleton():
+    s1 = obs_trace.span("round", attr=1)
+    s2 = obs_trace.span("other")
+    assert s1 is s2 is obs_trace.NULL_SPAN
+    with s1 as sp:
+        assert sp.set(more=2) is sp  # set() is safe when disabled
+    assert obs_trace.spans() == []
+    assert obs_trace.snapshot_totals() == {}
+
+
+def test_stage_timers_mode_accumulates_without_recording(monkeypatch):
+    monkeypatch.setenv(obs_trace.STAGE_ENV, "1")
+    for _ in range(3):
+        with obs_trace.span("round.cost_build"):
+            pass
+    totals = obs_trace.snapshot_totals()
+    assert totals["round.cost_build"][1] == 3
+    assert obs_trace.spans() == []  # aggregation only: no span objects
+
+
+def test_tracing_records_nested_spans(monkeypatch):
+    monkeypatch.setenv(obs_trace.TRACE_ENV, "1")
+    with obs_trace.span("round", solve_tier="dense") as outer:
+        with obs_trace.span("round.solve_band") as inner:
+            inner.set(band=0)
+    spans = obs_trace.spans()
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["round.solve_band"]["parent"] == by_name["round"]["id"]
+    assert by_name["round"]["parent"] is None
+    assert by_name["round"]["attrs"]["solve_tier"] == "dense"
+    assert by_name["round.solve_band"]["attrs"]["band"] == 0
+    # exceptions annotate the span
+    with pytest.raises(ValueError):
+        with obs_trace.span("glue.try_round"):
+            raise ValueError("boom")
+    failed = [s for s in obs_trace.spans() if s["name"] == "glue.try_round"]
+    assert failed[0]["attrs"]["error"] == "ValueError"
+
+
+def test_current_span_attribution(monkeypatch):
+    monkeypatch.setenv(obs_trace.TRACE_ENV, "1")
+    assert obs_trace.current() is obs_trace.NULL_SPAN
+    with obs_trace.span("round"):
+        obs_trace.current().set(fresh_compiles=2)
+    rec = obs_trace.spans()[-1]
+    assert rec["attrs"]["fresh_compiles"] == 2
+
+
+def test_span_buffer_cap_counts_drops():
+    tracer = obs_trace.Tracer(max_spans=2)
+    tracer.force = True
+    for i in range(5):
+        with tracer.span(f"s{i}"):
+            pass
+    assert len(tracer.spans()) == 2
+    assert tracer.dropped == 3
+    # aggregates stay honest past the cap
+    assert len(tracer.snapshot_totals()) == 5
+
+
+def test_span_stagetimer_parity_under_concurrent_rounds():
+    """Total-time parity: spans and stagetimer totals are two views of
+    the same records, and concurrent rounds must not lose time (the
+    process-global-dict race this shim replaced)."""
+    from poseidon_tpu.utils import stagetimer
+
+    tracer = obs_trace.tracer()
+    tracer.force = True
+    n_threads, n_rounds = 4, 25
+
+    def one_thread(k: int) -> None:
+        for _ in range(n_rounds):
+            with stagetimer.stage("round"):
+                with stagetimer.stage("round.solve_band"):
+                    time.sleep(0.0002)
+
+    threads = [
+        threading.Thread(target=one_thread, args=(k,), name=f"w{k}")
+        for k in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    snap = stagetimer.snapshot()
+    span_view = obs_trace.span_totals(obs_trace.spans())
+    expect = n_threads * n_rounds
+    for name in ("round", "round.solve_band"):
+        assert snap[name][1] == expect, f"{name}: lost stagetimer calls"
+        assert span_view[name][1] == expect, f"{name}: lost spans"
+        # 5%: the acceptance band for the two views of the same rounds
+        assert span_view[name][0] == pytest.approx(
+            snap[name][0], rel=0.05
+        )
+
+
+def test_stagetimer_shim_api_preserved(monkeypatch):
+    from poseidon_tpu.utils import stagetimer
+
+    assert not stagetimer.enabled()
+    monkeypatch.setenv("POSEIDON_STAGE_TIMERS", "1")
+    assert stagetimer.enabled()
+    with stagetimer.stage("round.mask_build"):
+        pass
+    assert "round.mask_build" in stagetimer.snapshot()
+    assert "round.mask_build" in stagetimer.report()
+    stagetimer.reset()
+    assert stagetimer.snapshot() == {}
+
+
+# ------------------------------------------------------------ chrome trace
+
+
+def test_chrome_trace_export_is_valid_and_nested(monkeypatch, tmp_path):
+    monkeypatch.setenv(obs_trace.TRACE_ENV, "1")
+    for r in range(2):
+        with obs_trace.span("round", round=r):
+            with obs_trace.span("round.cost_build"):
+                pass
+            with obs_trace.span("round.solve_band"):
+                with obs_trace.span("solve.device_wait"):
+                    pass
+    path = tmp_path / "trace.json"
+    obj = obs_trace.export_chrome_trace(str(path))
+    assert obs_trace.validate_chrome_trace(obj) == []
+    on_disk = json.loads(path.read_text())
+    assert on_disk["traceEvents"]  # serialized artifact parses back
+    events = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+    rounds = [e for e in events if e["name"] == "round"]
+    assert len(rounds) == 2
+    stages = [e for e in events if e["name"].startswith("round.")]
+    round_ids = {e["args"]["span_id"] for e in rounds}
+    assert all(e["args"]["parent_id"] in round_ids for e in stages)
+    # thread metadata lane exists
+    assert any(e["ph"] == "M" and e["name"] == "thread_name"
+               for e in obj["traceEvents"])
+
+
+def test_chrome_trace_validator_catches_partial_overlap():
+    bad = {"traceEvents": [
+        {"name": "a", "ph": "X", "ts": 0, "dur": 100, "pid": 1, "tid": 1},
+        {"name": "b", "ph": "X", "ts": 50, "dur": 100, "pid": 1, "tid": 1},
+    ]}
+    problems = obs_trace.validate_chrome_trace(bad)
+    assert any("partially overlaps" in p for p in problems)
+    assert obs_trace.validate_chrome_trace(
+        {"traceEvents": [{"name": "a", "ph": "X", "ts": 0, "dur": 1,
+                          "pid": 1}]}
+    )  # missing tid flags
+
+
+def test_chrome_trace_attrs_are_json_safe(monkeypatch):
+    monkeypatch.setenv(obs_trace.TRACE_ENV, "1")
+    with obs_trace.span("round", obj=object(), ok=True, n=3):
+        pass
+    obj = obs_trace.chrome_trace(obs_trace.spans())
+    json.dumps(obj)  # must not raise
+    args = [e for e in obj["traceEvents"] if e["ph"] == "X"][0]["args"]
+    assert isinstance(args["obj"], str) and args["n"] == 3
+
+
+# -------------------------------------------------------------- prometheus
+
+
+def _lines(text: str):
+    return [ln for ln in text.splitlines() if ln]
+
+
+def test_exposition_format_conformance():
+    reg = obs_metrics.Registry()
+    c = reg.counter("poseidon_test_total", "helpful\ntext", ("rpc",))
+    c.inc(2.5, 'we"ird\\lab\nel')
+    g = reg.gauge("poseidon_gauge", "a gauge")
+    g.set(-1.5)
+    text = reg.expose()
+    # HELP newline escaping
+    assert '# HELP poseidon_test_total helpful\\ntext' in text
+    # label value escaping: backslash, quote, newline
+    assert 'rpc="we\\"ird\\\\lab\\nel"' in text
+    assert "poseidon_gauge -1.5" in text
+    # every sample line parses as <name>{labels}? <value>
+    sample_re = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+        r"(-?[0-9.e+-]+|\+Inf|-Inf|NaN)$"
+    )
+    for ln in _lines(text):
+        if ln.startswith("#"):
+            assert ln.startswith("# HELP") or ln.startswith("# TYPE")
+        else:
+            assert sample_re.match(ln), f"malformed sample line: {ln!r}"
+
+
+def test_histogram_bucket_monotonicity_and_sum():
+    reg = obs_metrics.Registry()
+    h = reg.histogram("poseidon_lat_seconds", "latency",
+                      buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0, 0.05):
+        h.observe(v)
+    text = reg.expose()
+    buckets = []
+    for ln in _lines(text):
+        m = re.match(r'poseidon_lat_seconds_bucket\{le="([^"]+)"\} (\d+)',
+                     ln)
+        if m:
+            buckets.append((m.group(1), int(m.group(2))))
+    assert [b[0] for b in buckets] == ["0.01", "0.1", "1", "+Inf"]
+    counts = [b[1] for b in buckets]
+    assert counts == sorted(counts), "buckets must be cumulative"
+    assert counts[-1] == 5
+    assert "poseidon_lat_seconds_count 5" in text
+    m = re.search(r"poseidon_lat_seconds_sum (\S+)", text)
+    assert float(m.group(1)) == pytest.approx(5.605)
+
+
+def test_counter_discipline():
+    reg = obs_metrics.Registry()
+    c = reg.counter("poseidon_x_total")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    c.set_total(10.0)
+    c.set_total(4.0)  # external regression clamps, never goes back
+    assert c.value() == 10.0
+    with pytest.raises(ValueError):
+        reg.gauge("poseidon_x_total")  # type change is an error
+    with pytest.raises(ValueError):
+        reg.counter("bad-name")
+    with pytest.raises(ValueError):
+        reg.counter("ok_total", "", ("bad-label",))
+
+
+def test_metrics_server_serves_exposition():
+    reg = obs_metrics.Registry()
+    reg.counter("poseidon_up_total", "updates").inc()
+    server = obs_metrics.MetricsServer("127.0.0.1:0", registry=reg).start()
+    try:
+        base = f"http://{server.address}"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as resp:
+            body = resp.read().decode()
+            assert resp.headers["Content-Type"] == obs_metrics.CONTENT_TYPE
+        assert "poseidon_up_total 1" in body
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as resp:
+            assert resp.read() == b"ok\n"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope", timeout=5)
+    finally:
+        server.stop()
+
+
+def test_poseidon_serves_metrics_end_to_end():
+    """Full wiring: Poseidon(metrics_address=...) starts the exporter,
+    a scheduled round feeds the default registry from every layer
+    (server-side RoundMetrics, glue LoopStats, client RPC counters),
+    and one scrape sees them all."""
+    from poseidon_tpu.glue import FakeKube, Node, Pod, Poseidon
+    from poseidon_tpu.service.server import FirmamentTPUServer
+    from poseidon_tpu.utils.config import PoseidonConfig
+
+    with FirmamentTPUServer(address="127.0.0.1:0") as server:
+        kube = FakeKube()
+        cfg = PoseidonConfig(
+            firmament_address=server.address, scheduling_interval=3600,
+            metrics_address="127.0.0.1:0",
+        )
+        poseidon = Poseidon(kube, config=cfg, run_loop=False)
+        poseidon.start(health_timeout=10)
+        try:
+            assert poseidon.metrics_server is not None
+            kube.add_node(
+                Node(name="n1", cpu_capacity=4000, ram_capacity=1 << 24)
+            )
+            kube.create_pod(
+                Pod(name="p1", cpu_request=100, ram_request=1 << 20)
+            )
+            assert poseidon.drain_watchers()
+            assert poseidon.try_round() == cfg.scheduling_interval
+            url = f"http://{poseidon.metrics_server.address}/metrics"
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                body = resp.read().decode()
+        finally:
+            poseidon.stop()
+    # Series from all three layers land in one exposition (the default
+    # registry is process-global, so assert presence, not exact values).
+    assert "poseidon_rounds_observed_total" in body       # server feed
+    assert "poseidon_loop_rounds_total" in body           # glue feed
+    assert 'poseidon_client_rpc_attempts_total{rpc="Schedule"}' in body
+    assert 'poseidon_round_solve_tier{tier=' in body
+
+
+def test_firmament_server_serves_metrics():
+    """The SERVICE process exports too: the round metrics and compile
+    ledger live server-side, so the deployed two-pod topology scrapes
+    both pods (deploy/firmament-tpu-deployment.yaml annotations)."""
+    from poseidon_tpu.service.server import FirmamentTPUServer
+    from poseidon_tpu.utils.config import FirmamentTPUConfig
+
+    cfg = FirmamentTPUConfig(metrics_address="127.0.0.1:0")
+    with FirmamentTPUServer(address="127.0.0.1:0", config=cfg) as server:
+        assert server.metrics_server is not None
+        base = f"http://{server.metrics_server.address}"
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as resp:
+            assert resp.read() == b"ok\n"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as resp:
+            assert resp.status == 200
+    # Context exit stopped the exporter with the gRPC server.
+    with pytest.raises(OSError):
+        urllib.request.urlopen(f"{base}/healthz", timeout=2)
+
+
+def test_observe_round_schema_driven():
+    from poseidon_tpu.graph.instance import RoundMetrics
+
+    reg = obs_metrics.Registry()
+    m = RoundMetrics(round_index=7, solve_seconds=0.25, total_seconds=0.5,
+                     placed=42, solve_tier="pruned",
+                     gap_bound=float("inf"))
+    obs_metrics.observe_round(m, registry=reg)
+    obs_metrics.observe_round(m.to_dict(), registry=reg)  # dict feed too
+    text = reg.expose()
+    assert "poseidon_round_placed 42" in text
+    assert "poseidon_round_gap_bound +Inf" in text
+    assert 'poseidon_round_solve_tier{tier="pruned"} 1' in text
+    assert 'poseidon_round_solve_tier{tier="dense"} 0' in text
+    assert "poseidon_rounds_observed_total 2" in text
+    assert "poseidon_rounds_placed_total 84" in text
+    assert "poseidon_round_duration_seconds_count 2" in text
+    # solve_seconds is BOTH a schema gauge and a histogram basis; the
+    # names must not collide (the gauge keeps the field name).
+    assert "poseidon_round_solve_seconds 0.25" in text
+    assert "poseidon_round_solve_duration_seconds_count 2" in text
+
+
+def test_solve_tier_one_hot_clears_unknown_tiers():
+    """A tier name outside SOLVE_TIERS (added to instance.py before the
+    exporter's list) must not stay pinned at 1 after later rounds."""
+    from poseidon_tpu.graph.instance import RoundMetrics
+
+    reg = obs_metrics.Registry()
+    obs_metrics.observe_round(
+        RoundMetrics(round_index=0, solve_tier="experimental"), registry=reg
+    )
+    assert ('poseidon_round_solve_tier{tier="experimental"} 1'
+            in reg.expose())
+    obs_metrics.observe_round(
+        RoundMetrics(round_index=1, solve_tier="dense"), registry=reg
+    )
+    text = reg.expose()
+    assert 'poseidon_round_solve_tier{tier="experimental"} 0' in text
+    assert 'poseidon_round_solve_tier{tier="dense"} 1' in text
+    ones = re.findall(r'poseidon_round_solve_tier\{[^}]*\} 1\b', text)
+    assert len(ones) == 1  # one-hot
+
+
+def test_observe_loop_and_rpc_counters():
+    from poseidon_tpu.glue.poseidon import LoopStats
+
+    reg = obs_metrics.Registry()
+    stats = LoopStats()
+    stats.rounds, stats.failed_rounds = 5, 2
+    stats.consecutive_failures = 2
+    obs_metrics.observe_loop(stats, resyncs=3, crash_loop_budget=8,
+                             fatal=False, registry=reg)
+    obs_metrics.rpc_attempt("Schedule", registry=reg)
+    obs_metrics.rpc_error("Schedule", "UNAVAILABLE", retried=True,
+                          registry=reg)
+    obs_metrics.rpc_error("Schedule", "DEADLINE_EXCEEDED", retried=False,
+                          registry=reg)
+    obs_metrics.watch_event("pod", "added", registry=reg)
+    text = reg.expose()
+    assert "poseidon_loop_rounds_total 5" in text
+    assert "poseidon_loop_failed_rounds_total 2" in text
+    assert "poseidon_watch_resyncs_total 3" in text
+    assert "poseidon_loop_consecutive_failures 2" in text
+    assert 'poseidon_client_rpc_attempts_total{rpc="Schedule"} 1' in text
+    assert ('poseidon_client_rpc_errors_total'
+            '{rpc="Schedule",code="UNAVAILABLE"} 1') in text
+    assert 'poseidon_client_rpc_retries_total{rpc="Schedule"} 1' in text
+    assert 'poseidon_client_rpc_deadline_total{rpc="Schedule"} 1' in text
+    assert 'poseidon_watch_events_total{watcher="pod",kind="added"} 1' \
+        in text
+
+
+# ------------------------------------------------------ RoundMetrics wire
+
+
+def test_round_metrics_round_trip():
+    from poseidon_tpu.graph.instance import RoundMetrics
+
+    m = RoundMetrics(round_index=3, num_tasks=10, solve_seconds=1.5,
+                     gap_bound=float("inf"), solve_tier="host_greedy",
+                     converged=False)
+    d = m.to_dict()
+    assert d["schema"] == RoundMetrics.SCHEMA
+    assert d["gap_bound"] == "inf"  # JSON-safe
+    wire = json.loads(json.dumps(d))  # survives a real serialization
+    m2 = RoundMetrics.from_dict(wire)
+    assert m2 == m
+    # forward compat: unknown keys drop, missing keys default
+    m3 = RoundMetrics.from_dict({"round_index": 9, "future_field": 1})
+    assert m3.round_index == 9 and m3.solve_tier == "none"
+    with pytest.raises(ValueError):
+        RoundMetrics.from_dict({"schema": RoundMetrics.SCHEMA + 1})
+
+
+def test_soak_metrics_dict_uses_wire_format():
+    from poseidon_tpu.chaos.soak import _metrics_dict
+    from poseidon_tpu.graph.instance import RoundMetrics
+
+    m = RoundMetrics(round_index=1, gap_bound=float("inf"))
+    assert _metrics_dict(m) == m.to_dict()
+
+
+# --------------------------------------------------------- flight timeline
+
+
+def test_flight_timeline_rerenders_recorded_round(tmp_path):
+    from poseidon_tpu.chaos.plan import named_plan
+    from poseidon_tpu.chaos.recorder import FlightRecorder
+    from poseidon_tpu.replay.flight import flight_timeline
+
+    plan = named_plan("smoke", 2, seed=0)
+    recorder = FlightRecorder({"name": "smoke", "seed": 0},
+                              plan, out_dir=str(tmp_path))
+    spans = [
+        {"name": "round", "ts": 0.0, "dur": 0.5, "tid": 1,
+         "tname": "MainThread", "id": 1, "parent": None,
+         "attrs": {"solve_tier": "dense"}},
+        {"name": "round.solve_band", "ts": 0.1, "dur": 0.3, "tid": 1,
+         "tname": "MainThread", "id": 2, "parent": 1, "attrs": {}},
+    ]
+    recorder.record_round(0, faults=[], deltas=[], metrics={},
+                          digest="d0", placements=1, spans=spans)
+    path = recorder.record_failure(0, "divergence", "boom")
+    out = tmp_path / "timeline.json"
+    obj = flight_timeline(path, out_path=str(out))
+    assert obs_trace.validate_chrome_trace(obj) == []
+    assert obj["flightMeta"]["round"] == 0
+    events = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in events} == {"round", "round.solve_band"}
+    assert json.loads(out.read_text())["flightMeta"]["spans"] == 2
+    # An EXPLICITLY requested round that was never recorded raises (the
+    # last-completed-round fallback is for the default path only —
+    # silently rendering a different round would have the caller
+    # debugging the wrong timeline).
+    with pytest.raises(ValueError, match="round 5"):
+        flight_timeline(path, round_index=5)
+
+
+# --------------------------------------------------- determinism confinement
+
+
+def test_obs_clock_reads_confined_to_tracer():
+    from poseidon_tpu.check.determinism import DeterminismRule
+
+    rule = DeterminismRule()
+    src = "import time\n\ndef f():\n    return time.perf_counter()\n"
+    tree = ast.parse(src)
+    found = rule.check(tree, src, "poseidon_tpu/obs/metrics.py")
+    assert any("clock read" in f.message for f in found)
+    assert rule.check(tree, src, "poseidon_tpu/obs/trace.py") == []
+    # The exemption is the tracer EXACTLY — a module whose filename
+    # merely ends in "trace.py" is still confined.
+    found = rule.check(tree, src, "poseidon_tpu/obs/xtrace.py")
+    assert any("clock read" in f.message for f in found)
+    # outside obs/ the confinement does not apply (perf_counter is the
+    # sanctioned telemetry clock there)
+    assert rule.check(tree, src, "poseidon_tpu/graph/instance.py") == []
+    assert rule.applies_to("poseidon_tpu/obs/metrics.py")
+
+
+# ---------------------------------------------------------------- perf gate
+
+
+def _artifact(**over):
+    art = {
+        "metric": "schedule_round_s", "backend": "cpu",
+        "machines": 10_000, "tasks": 100_000,
+        "wave_p50_s": 4.0, "churn_p50_s": 0.2, "restart_s": 0.3,
+        "cold_s": 7.0,
+        "features": {
+            "backend": "cpu",
+            "selectors": {"round_p50_s": 0.06},
+            "pod_affinity": {"round_s": 2.2, "mask_build_s": 0.3,
+                             "cost_build_s": 0.4, "solve_s": 1.2,
+                             "view_build_s": 0.1},
+            "gang": {"round_s": 4.5, "mask_build_s": 0.001,
+                     "cost_build_s": 0.5, "solve_s": 3.8,
+                     "view_build_s": 0.1},
+        },
+    }
+    art.update(over)
+    return art
+
+
+def test_perf_gate_passes_identical_artifacts():
+    res = bench_compare.compare(_artifact(), _artifact())
+    assert res["comparable"] and res["regressions"] == []
+    names = {r["name"] for r in res["rows"]}
+    assert "features.gang.solve_s" in names
+    assert "wave_p50_s" in names
+    assert "cold_s" not in names  # cache-warmth-dependent; excluded
+
+
+def test_perf_gate_fails_on_synthetically_slowed_stage():
+    slowed = copy.deepcopy(_artifact())
+    slowed["features"]["gang"]["solve_s"] *= 2.0
+    res = bench_compare.compare(_artifact(), slowed)
+    assert res["regressions"] == ["features.gang.solve_s"]
+    # ... but a tiny stage doubling under the absolute floor is noise
+    noisy = copy.deepcopy(_artifact())
+    noisy["features"]["gang"]["mask_build_s"] *= 2.0
+    assert bench_compare.compare(_artifact(), noisy)["regressions"] == []
+
+
+def test_perf_gate_never_compares_apples_to_oranges():
+    res = bench_compare.compare(_artifact(), _artifact(backend="tpu"))
+    assert not res["comparable"] and "mismatch" in res["reason"]
+    res = bench_compare.compare(_artifact(), _artifact(machines=200))
+    assert not res["comparable"]
+    missing = _artifact()
+    del missing["features"]["gang"]
+    res = bench_compare.compare(_artifact(), missing)
+    assert res["comparable"]
+    assert "features.gang.solve_s" in res["skipped"]
+
+
+def test_perf_gate_cli_exit_codes(tmp_path, capsys):
+    base, cur = tmp_path / "base.json", tmp_path / "cur.json"
+    base.write_text(json.dumps(_artifact()))
+    slowed = copy.deepcopy(_artifact())
+    slowed["features"]["gang"]["solve_s"] *= 2.0
+    cur.write_text(json.dumps(slowed))
+    argv = ["--baseline", str(base), "--current", str(cur)]
+    assert bench_compare.main(argv) == 1
+    assert bench_compare.main(argv + ["--warn-only"]) == 0
+    assert "regression" in capsys.readouterr().out
+    # missing current artifact: 2 strict, 0 warn-only
+    gone = ["--baseline", str(base), "--current", str(tmp_path / "nope")]
+    assert bench_compare.main(gone) == 2
+    assert bench_compare.main(gone + ["--warn-only"]) == 0
+    # jsonl stream: the LAST parseable line wins
+    stream = tmp_path / "cur.jsonl"
+    stream.write_text(
+        json.dumps(_artifact(wave_p50_s=99.0)) + "\n"
+        + "not json\n" + json.dumps(_artifact()) + "\n"
+    )
+    assert bench_compare.main(
+        ["--baseline", str(base), "--current", str(stream)]) == 0
+
+
+def test_perf_gate_reads_committed_baselines():
+    """The default baseline chain (the Makefile's PERF_BASELINES) must
+    yield a parseable artifact from the repo as committed, and the
+    winning baseline must carry the per-stage features series — without
+    them every stage comparison lands in 'skipped' and the per-stage
+    gate is vacuous."""
+    art, path = bench_compare.first_artifact(
+        [str(REPO / "docs" / "bench_r06_baseline.json"),
+         str(REPO / "docs" / "bench_r05_final.json")]
+    )
+    assert art is not None and "features" in art, path
+    timings = bench_compare.collect_timings(art)
+    for stage in ("mask_build_s", "cost_build_s", "solve_s",
+                  "view_build_s"):
+        assert f"features.pod_affinity.{stage}" in timings
+        assert f"features.gang.{stage}" in timings
+
+
+# ------------------------------------------------------- trace smoke logic
+
+
+def test_trace_smoke_validators():
+    import trace_smoke
+
+    spans = [
+        {"name": "round", "ts": 0.0, "dur": 1.0, "tid": 1, "tname": "t",
+         "id": 1, "parent": None, "attrs": {}},
+    ]
+    for i, stage in enumerate(trace_smoke.STAGES):
+        spans.append({"name": stage, "ts": 0.1 * (i + 1), "dur": 0.05,
+                      "tid": 1, "tname": "t", "id": i + 2, "parent": 1,
+                      "attrs": {}})
+    problems = []
+    trace_smoke.validate_round_decomposition(spans, problems)
+    assert problems == []
+    snapshot = {s["name"]: (s["dur"], 1) for s in spans}
+    trace_smoke.validate_stagetimer_parity(spans, snapshot, problems)
+    assert problems == []
+    # drifted totals are caught
+    bad_snapshot = dict(snapshot)
+    bad_snapshot["round.solve_band"] = (0.5, 1)
+    trace_smoke.validate_stagetimer_parity(spans, bad_snapshot, problems)
+    assert problems
+    # a stage outside its round flags
+    orphan = [dict(spans[0]), dict(spans[1])]
+    orphan[1]["parent"] = None
+    probs2 = []
+    trace_smoke.validate_round_decomposition(orphan, probs2)
+    assert probs2

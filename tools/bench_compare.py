@@ -1,0 +1,234 @@
+"""Perf-regression gate: diff a fresh bench artifact against a baseline.
+
+``make perf-gate`` runs this against the committed round baseline
+(BENCH_r05.json, falling back to docs/bench_r05_final.json — the
+driver-wrapper format truncates its embedded JSON).  Every overlapping
+TIMING series — the headline wave/churn p50s, the restart-recovery
+round, and the features stages' per-stage decomposition (mask build /
+cost build / solve / view build, the ``stagetimer`` names the obs
+tracer accumulates) — is compared, and the gate fails when the fresh
+number exceeds the baseline by more than the tolerance band.
+
+Honesty rules (the same ones bench.py's scoring learned the hard way):
+
+- apples to apples only: timings compare ONLY when both artifacts ran
+  the same backend and the same target config — a CPU run is never
+  judged against a TPU baseline, and a 200-machine smoke is never
+  "faster" than the 10k baseline;
+- a missing series in EITHER artifact is reported as skipped, never
+  silently dropped from the verdict line;
+- tiny stages get an absolute floor: a 3 ms stage doubling to 6 ms is
+  measurement noise, not a regression.
+
+Exit codes: 0 = no regressions (or ``--warn-only``), 1 = regression(s),
+2 = unusable inputs without ``--warn-only`` (missing/corrupt artifact).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_TOLERANCE = 0.35   # fail past baseline * (1 + tolerance) ...
+DEFAULT_ABS_FLOOR_S = 0.05  # ... and only if the delta clears this floor
+
+# (dotted series name, path into the artifact dict)
+_FEATURE_STAGES = (
+    "round_s", "round_p50_s", "mask_build_s", "cost_build_s",
+    "solve_s", "view_build_s",
+)
+
+
+def load_artifact(path: str) -> Optional[dict]:
+    """Parse a bench artifact: a plain JSON object, a ``.jsonl`` stream
+    (last parseable object wins — bench.py emits superset lines), or
+    the driver wrapper format (``{"parsed": {...}, "tail": "..."}``).
+    Returns None when nothing parseable is found."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError:
+        return None
+    objs: List[dict] = []
+    for line in text.splitlines():
+        if line.startswith("{"):
+            try:
+                objs.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    if not objs:
+        try:
+            objs.append(json.loads(text))
+        except json.JSONDecodeError:
+            return None
+    art = objs[-1]
+    if "metric" not in art and ("parsed" in art or "tail" in art):
+        parsed = art.get("parsed")
+        if isinstance(parsed, dict):
+            return parsed
+        tail = art.get("tail", "")
+        # The wrapper truncates tail from the FRONT; recoverable only
+        # when a whole JSON line survived.
+        start = tail.find('{"metric"')
+        if start >= 0:
+            try:
+                return json.loads(tail[start:])
+            except json.JSONDecodeError:
+                return None
+        return None
+    return art
+
+
+def first_artifact(paths: List[str]) -> Tuple[Optional[dict], Optional[str]]:
+    for p in paths:
+        art = load_artifact(p)
+        if art is not None:
+            return art, p
+    return None, None
+
+
+def _config_key(art: dict) -> Tuple:
+    return (
+        art.get("backend"),
+        art.get("target_machines", art.get("machines")),
+        art.get("target_tasks", art.get("tasks")),
+    )
+
+
+def collect_timings(art: dict) -> Dict[str, float]:
+    """Flatten an artifact's timing series to {dotted_name: seconds}.
+
+    Only steady-state numbers: ``cold_s`` depends on compile-cache
+    warmth (the artifact says so via ``cache_warm``) and is excluded —
+    a cache-cold run must not fail the gate on compile time."""
+    out: Dict[str, float] = {}
+    for key in ("wave_p50_s", "churn_p50_s", "restart_s"):
+        val = art.get(key)
+        if isinstance(val, (int, float)):
+            out[key] = float(val)
+    features = art.get("features") or {}
+    for config in ("selectors", "pod_affinity", "gang"):
+        sub = features.get(config) or {}
+        for stage in _FEATURE_STAGES:
+            val = sub.get(stage)
+            if isinstance(val, (int, float)):
+                out[f"features.{config}.{stage}"] = float(val)
+    return out
+
+
+def compare(
+    baseline: dict,
+    current: dict,
+    tolerance: float = DEFAULT_TOLERANCE,
+    abs_floor_s: float = DEFAULT_ABS_FLOOR_S,
+) -> dict:
+    """Pure comparison (tests pin this contract).  Returns::
+
+        {"comparable": bool, "reason": str|None,
+         "rows": [{"name", "baseline_s", "current_s", "ratio",
+                   "verdict": "ok"|"regression"|"improved"}, ...],
+         "skipped": [names missing on one side],
+         "regressions": [names]}
+    """
+    base_key, cur_key = _config_key(baseline), _config_key(current)
+    if base_key != cur_key:
+        return {
+            "comparable": False,
+            "reason": (
+                f"config mismatch: baseline {base_key} vs current "
+                f"{cur_key} (backend/machines/tasks must match)"
+            ),
+            "rows": [], "skipped": [], "regressions": [],
+        }
+    base_t, cur_t = collect_timings(baseline), collect_timings(current)
+    rows, regressions = [], []
+    skipped = sorted(set(base_t) ^ set(cur_t))
+    for name in sorted(set(base_t) & set(cur_t)):
+        b, c = base_t[name], cur_t[name]
+        ratio = (c / b) if b > 0 else float("inf")
+        verdict = "ok"
+        if c > b * (1.0 + tolerance) and (c - b) > abs_floor_s:
+            verdict = "regression"
+            regressions.append(name)
+        elif c < b * (1.0 - tolerance) and (b - c) > abs_floor_s:
+            verdict = "improved"
+        rows.append({
+            "name": name, "baseline_s": b, "current_s": c,
+            "ratio": round(ratio, 3), "verdict": verdict,
+        })
+    return {
+        "comparable": True, "reason": None, "rows": rows,
+        "skipped": skipped, "regressions": regressions,
+    }
+
+
+def render(result: dict, baseline_path: str, current_path: str) -> str:
+    lines = [f"perf-gate: {current_path} vs baseline {baseline_path}"]
+    if not result["comparable"]:
+        lines.append(f"  SKIP: {result['reason']}")
+        return "\n".join(lines)
+    width = max((len(r["name"]) for r in result["rows"]), default=4)
+    lines.append(
+        f"  {'series'.ljust(width)}  baseline_s  current_s   ratio  verdict"
+    )
+    for r in result["rows"]:
+        lines.append(
+            f"  {r['name'].ljust(width)}  {r['baseline_s']:10.4f}  "
+            f"{r['current_s']:9.4f}  {r['ratio']:6.3f}  {r['verdict']}"
+        )
+    for name in result["skipped"]:
+        lines.append(f"  {name.ljust(width)}  (present on one side only; "
+                     "skipped)")
+    n = len(result["regressions"])
+    lines.append(
+        f"  => {n} regression(s)" if n else "  => no regressions"
+    )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--baseline", action="append", default=[],
+                   help="baseline artifact path; repeatable — the first "
+                        "parseable one wins (wrapper formats may be "
+                        "truncated)")
+    p.add_argument("--current", required=True,
+                   help="fresh bench artifact (.json or .jsonl; last "
+                        "parseable line wins)")
+    p.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                   help="allowed fractional slowdown before failing "
+                        f"(default {DEFAULT_TOLERANCE})")
+    p.add_argument("--abs-floor", type=float, default=DEFAULT_ABS_FLOOR_S,
+                   help="minimum absolute slowdown in seconds to count "
+                        f"(default {DEFAULT_ABS_FLOOR_S})")
+    p.add_argument("--warn-only", action="store_true",
+                   help="report regressions but always exit 0 (the "
+                        "`make verify` wiring)")
+    args = p.parse_args(argv)
+
+    baselines = args.baseline or ["BENCH_r05.json",
+                                  "docs/bench_r05_final.json"]
+    baseline, baseline_path = first_artifact(baselines)
+    current = load_artifact(args.current)
+    if baseline is None or current is None:
+        which = "baseline" if baseline is None else "current"
+        missing = baselines if baseline is None else [args.current]
+        print(f"perf-gate: no parseable {which} artifact in {missing}",
+              file=sys.stderr)
+        return 0 if args.warn_only else 2
+
+    result = compare(baseline, current, tolerance=args.tolerance,
+                     abs_floor_s=args.abs_floor)
+    print(render(result, baseline_path, args.current))
+    if result["regressions"] and not args.warn_only:
+        return 1
+    if result["regressions"]:
+        print("perf-gate: WARN-ONLY mode; regressions above are not "
+              "failing the build", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,154 @@
+"""Trace smoke (``make trace-smoke``): one features-config round with
+tracing ON, exported and validated end to end.
+
+Drives a small selector-config round (the BASELINE config-2 shape at
+smoke scale) under ``POSEIDON_TRACE=1``, exports the Chrome trace-event
+artifact to ``out/trace_smoke.json``, and fails unless:
+
+- the export passes ``obs.trace.validate_chrome_trace`` (JSON-
+  serializable, complete events, properly NESTED same-thread spans —
+  the Perfetto-loadability contract);
+- a ``round`` span exists and the stage spans
+  (``round.mask_build`` / ``round.cost_build`` / ``round.solve_band`` /
+  ``round.view_build``) are its children, contained in its interval;
+- the span totals agree with ``stagetimer.snapshot()`` within 5%
+  (tracer and stagetimer are two views of the same records — drift
+  means the shim broke).
+
+CPU-pinned: a smoke gate must never contend for (or wedge on) the
+accelerator tunnel.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STAGES = (
+    "round.view_build", "round.mask_build", "round.cost_build",
+    "round.solve_band",
+)
+PARITY_TOLERANCE = 0.05
+OUT_PATH = os.path.join("out", "trace_smoke.json")
+
+
+def validate_round_decomposition(spans, problems):
+    """The round span must ancestor the stage spans (mask_build nests
+    under cost_build — the cost model opens it), intervals contained."""
+    rounds = [s for s in spans if s["name"] == "round"]
+    if not rounds:
+        problems.append("no 'round' span recorded")
+        return
+    rnd = rounds[-1]
+    r0, r1 = rnd["ts"], rnd["ts"] + rnd["dur"]
+    by_id = {s["id"]: s for s in spans}
+    by_parent = {}
+    for s in spans:
+        by_parent.setdefault(s.get("parent"), []).append(s)
+
+    def descends_from_round(span) -> bool:
+        seen = set()
+        parent = span.get("parent")
+        while parent is not None and parent not in seen:
+            if parent == rnd["id"]:
+                return True
+            seen.add(parent)
+            parent = by_id.get(parent, {}).get("parent")
+        return False
+
+    for stage in STAGES:
+        stage_spans = [s for s in spans if s["name"] == stage
+                       and descends_from_round(s)]
+        if not stage_spans:
+            problems.append(
+                f"stage span {stage!r} is not a descendant of the "
+                "round span"
+            )
+            continue
+        for s in stage_spans:
+            if not (r0 <= s["ts"] and s["ts"] + s["dur"] <= r1 + 1e-9):
+                problems.append(
+                    f"stage span {stage!r} interval escapes its round span"
+                )
+    stage_sum = sum(
+        s["dur"] for s in by_parent.get(rnd["id"], [])
+        if s["name"].startswith("round.")
+    )
+    if stage_sum > rnd["dur"] * 1.001:
+        problems.append(
+            f"stage spans sum to {stage_sum:.4f}s > round span "
+            f"{rnd['dur']:.4f}s"
+        )
+
+
+def validate_stagetimer_parity(spans, snapshot, problems):
+    from poseidon_tpu.obs.trace import span_totals
+
+    totals = span_totals(spans)
+    for stage in STAGES:
+        span_s, span_n = totals.get(stage, (0.0, 0))
+        timer_s, timer_n = snapshot.get(stage, (0.0, 0))
+        if span_n != timer_n:
+            problems.append(
+                f"{stage}: {span_n} spans vs {timer_n} stagetimer calls"
+            )
+        ref = max(timer_s, 1e-9)
+        if abs(span_s - timer_s) / ref > PARITY_TOLERANCE:
+            problems.append(
+                f"{stage}: span total {span_s:.4f}s vs stagetimer "
+                f"{timer_s:.4f}s (> {PARITY_TOLERANCE:.0%} apart)"
+            )
+
+
+def main() -> int:
+    # CPU pin BEFORE jax loads a backend (same recipe as tests/conftest:
+    # env alone is too late when a site hook pre-registered a plugin).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["POSEIDON_TRACE"] = "1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from bench import build_cluster, submit_population
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+    from poseidon_tpu.obs import trace as obs_trace
+    from poseidon_tpu.utils import stagetimer
+
+    machines, tasks = 200, 1000
+    state = build_cluster(machines, tasks, 16, seed=0)
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+    planner.schedule_round()          # cold round: compiles land here
+    obs_trace.reset()                 # a clean traced window
+    submit_population(state, tasks // 10, 16, seed=1)
+    _, metrics = planner.schedule_round()  # THE traced round
+
+    spans = obs_trace.spans()
+    snapshot = stagetimer.snapshot()
+    obj = obs_trace.export_chrome_trace(OUT_PATH)
+
+    problems = obs_trace.validate_chrome_trace(obj)
+    validate_round_decomposition(spans, problems)
+    validate_stagetimer_parity(spans, snapshot, problems)
+    if not any(e.get("ph") == "X" and e["name"] == "round"
+               for e in obj["traceEvents"]):
+        problems.append("exported artifact has no 'round' event")
+
+    n_events = sum(1 for e in obj["traceEvents"] if e.get("ph") == "X")
+    print(f"trace-smoke: round solve_tier={metrics.solve_tier} "
+          f"placed={metrics.placed}; {len(spans)} spans, "
+          f"{n_events} events -> {OUT_PATH}")
+    if problems:
+        for prob in problems:
+            print(f"trace-smoke: FAIL {prob}", file=sys.stderr)
+        return 1
+    print("trace-smoke: artifact valid (nesting, Perfetto format, "
+          "stagetimer parity)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
