@@ -402,21 +402,33 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
         )
         print(json.dumps(partial), flush=True)
 
-    # Steady-state churn: replace 1% of tasks per round.
+    # Steady-state churn: replace 1% of tasks per round.  Same-shape
+    # resubmissions keep EC ids stable, so these are the rounds the
+    # delta-maintained cost planes (costmodel/delta.py) must serve —
+    # the per-round hit/rebuild telemetry rides the artifact so a
+    # silently-vanished incremental path is visible, not inferred.
     rng = np.random.default_rng(12345)
     churn_lat = []
+    churn_delta_hits = []
+    churn_rows_rebuilt = churn_cols_rebuilt = 0
     for r in range(rounds):
         churn_step(state, rng)
         t0 = time.perf_counter()
         _, metrics = planner.schedule_round()
         dt = time.perf_counter() - t0
         churn_lat.append(dt)
+        churn_delta_hits.append(metrics.cost_delta_hits)
+        churn_rows_rebuilt += metrics.cost_rows_rebuilt
+        churn_cols_rebuilt += metrics.cost_cols_rebuilt
         converged = converged and metrics.converged
         if verbose:
             print(f"# [{machines}] churn {r}: {dt:.3f}s "
                   f"solve={metrics.solve_seconds:.3f}s "
                   f"iters={metrics.iterations} bf={metrics.bf_sweeps} "
-                  f"calls={metrics.device_calls}",
+                  f"calls={metrics.device_calls} "
+                  f"delta_hits={metrics.cost_delta_hits} "
+                  f"rows/cols_rebuilt={metrics.cost_rows_rebuilt}/"
+                  f"{metrics.cost_cols_rebuilt}",
                   file=sys.stderr)
 
     # Recovery-to-first-placement: checkpoint the live state (placements
@@ -447,6 +459,9 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
         "precompile_s": round(precompile_s, 4),
         "wave_p50_s": round(float(np.percentile(wave_lat, 50)), 4),
         "churn_p50_s": round(float(np.percentile(churn_lat, 50)), 4),
+        "churn_delta_hits": churn_delta_hits,
+        "churn_rows_rebuilt": churn_rows_rebuilt,
+        "churn_cols_rebuilt": churn_cols_rebuilt,
         "restart_round_s": round(restart_s, 4),
         "restart_iters": m_restart.iterations,
         "placed": placed,
@@ -571,6 +586,7 @@ def run_features(machines: int, rounds: int) -> dict:
     planner = RoundPlanner(state, get_cost_model("cpu_mem"))
     lat = []
     fresh_per_round = []
+    delta_hits_per_round = []
     m = None
     for r in range(rounds):
         t0 = time.perf_counter()
@@ -587,6 +603,7 @@ def run_features(machines: int, rounds: int) -> dict:
                 _, m = planner.schedule_round()
         lat.append(time.perf_counter() - t0)
         fresh_per_round.append(m.fresh_compiles)
+        delta_hits_per_round.append(m.cost_delta_hits)
         submit_population(state, tasks // 100, 16, seed=r + 1)  # churn
     violations = zoned_placed = 0
     for uid, is_zoned in zoned.items():
@@ -614,6 +631,11 @@ def run_features(machines: int, rounds: int) -> dict:
         # 0 — PR 3's invariant, now a visible artifact column.
         "fresh_compiles": fresh_per_round,
         "warm_fresh_compiles": sum(fresh_per_round[1:]),
+        # Delta-plane serves per round (all-new churn ECs legitimately
+        # rebuild full: the incremental path's home is the same-shape
+        # churn loop in run_rung, whose artifact carries its own
+        # churn_delta_hits series).
+        "cost_delta_hits": delta_hits_per_round,
     }
     # Partial line per completed stage (the parent salvages these on a
     # timeout, same contract as the rung/trace children).

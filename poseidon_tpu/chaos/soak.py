@@ -232,7 +232,7 @@ def run_soak(
         "rounds_requested": rounds, "rounds_run": 0,
         "families_covered": list(fault_plan.families_covered()),
         "digests": [], "warm_fresh_compiles": 0, "tiers": [],
-        "divergent_rounds": 0,
+        "divergent_rounds": 0, "cost_delta_hits": 0,
     }
     if expect_digests is not None:
         result["digest_mismatches"] = []
@@ -406,6 +406,7 @@ def run_soak(
             # planner's own solve window — record both.
             metrics_d["soak_fresh_compiles"] = fresh
             result["tiers"].append(metrics.solve_tier)
+            result["cost_delta_hits"] += metrics.cost_delta_hits
             digest = _digest(kube_truth)
             result["digests"].append(digest)
             result["rounds_run"] = r + 1

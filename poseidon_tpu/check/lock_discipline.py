@@ -179,7 +179,11 @@ class _MethodScanner(ast.NodeVisitor):
 
 class LockDisciplineRule(Rule):
     name = "lock-discipline"
-    scopes = ("poseidon_tpu/glue/",)
+    # graph/pipeline.py: the cross-band cost-build pipeline's worker
+    # shares the plane cache with the planner thread — its lock
+    # discipline (every cache touch joins the outstanding future under
+    # _lock) is exactly this rule's compound-invariant territory.
+    scopes = ("poseidon_tpu/glue/", "poseidon_tpu/graph/pipeline.py")
 
     def check(self, tree: ast.AST, source: str, path: str) -> List[Finding]:
         factories = _lock_factory_names(tree)

@@ -140,7 +140,39 @@ class CostModel:
 
     name: str = "base"
 
+    # Delta-plane opt-in (costmodel/delta.CostPlaneCache): True declares
+    # that every cost/arc-capacity CELL [e, m] is a pure function of
+    # (row attributes captured by the EC id + the EC's representative
+    # labels) x (the machine-side inputs listed by ``delta_col_arrays``
+    # plus machine labels and resident-label counts) — i.e. building the
+    # model on row/column-sliced tables yields bit-identical cells to
+    # the full build.  Models reading cross-machine aggregates
+    # (type_census rollups, running_by_machine, ...) must NOT opt in.
+    delta_plane: bool = False
+
     def build(self, ecs: ECTable, machines: MachineTable) -> CostMatrices:
+        raise NotImplementedError
+
+    def build_unsched(self, ecs: ECTable) -> np.ndarray:
+        """The per-EC unscheduled-cost vector ``build`` would emit —
+        factored out so the delta-plane cache can refresh the O(E)
+        vector every round while reusing cached [E, M] cells.  Required
+        for ``delta_plane`` models; others may leave it unimplemented."""
+        raise NotImplementedError
+
+    def build_capacity(self, machines: MachineTable) -> np.ndarray:
+        """The per-machine slot-capacity vector ``build`` would emit
+        (recomputed fresh by the delta-plane cache — slot churn must
+        never be masked by cached matrices)."""
+        return machines.slots_free.astype(np.int32)
+
+    def delta_col_arrays(self, machines: MachineTable):
+        """``[(name, array-or-None), ...]`` — the machine-side numeric
+        inputs this model's cells read (column dirtiness is their
+        vectorized diff).  Labels and resident counts are diffed by the
+        cache itself; arrays that only feed per-machine VECTORS (e.g.
+        slots_free -> capacity) must be left out, or every slot change
+        would dirty the whole column."""
         raise NotImplementedError
 
     def max_cost(self) -> int:
@@ -151,6 +183,113 @@ class CostModel:
         the actual cost range cannot mint fresh XLA compiles.  Every
         bundled model clips its outputs within 8x NORMALIZED_COST."""
         return 8 * NORMALIZED_COST
+
+
+def slice_ecs(ecs: ECTable, idx) -> ECTable:
+    """Row-sliced ECTable view (shared by the planner's band ladder and
+    the delta-plane cache's dirty-row rebuilds).  ``idx`` is an integer
+    index array."""
+    rows = [int(i) for i in idx]
+    return ECTable(
+        ec_ids=ecs.ec_ids[idx],
+        cpu_request=ecs.cpu_request[idx],
+        ram_request=ecs.ram_request[idx],
+        supply=ecs.supply[idx],
+        priority=ecs.priority[idx],
+        task_type=ecs.task_type[idx],
+        max_wait_rounds=ecs.max_wait_rounds[idx],
+        selectors=[ecs.selectors[i] for i in rows],
+        net_rx_request=(
+            ecs.net_rx_request[idx]
+            if ecs.net_rx_request is not None else None
+        ),
+        running_by_machine=(
+            ecs.running_by_machine[idx]
+            if ecs.running_by_machine is not None else None
+        ),
+        is_gang=ecs.is_gang[idx] if ecs.is_gang is not None else None,
+        pod_affinity=(
+            [ecs.pod_affinity[i] for i in rows]
+            if ecs.pod_affinity is not None else None
+        ),
+        pod_anti_affinity=(
+            [ecs.pod_anti_affinity[i] for i in rows]
+            if ecs.pod_anti_affinity is not None else None
+        ),
+        labels=(
+            [ecs.labels[i] for i in rows]
+            if ecs.labels is not None else None
+        ),
+    )
+
+
+def slice_machines(machines: MachineTable, idx) -> MachineTable:
+    """Column-sliced MachineTable view (delta-plane dirty-column
+    rebuilds).  Interned index structures slice by machine row; their
+    id dicts are shared snapshots."""
+    from dataclasses import replace
+
+    from poseidon_tpu.graph.residency import (
+        MachineLabelIndex,
+        ResidentCounts,
+    )
+
+    cols = [int(j) for j in idx]
+    residents = machines.residents
+    if residents is not None:
+        residents = ResidentCounts(
+            kv_counts=residents.kv_counts[idx],
+            key_counts=residents.key_counts[idx],
+            total=residents.total[idx],
+            kv_id=residents.kv_id,
+            key_id=residents.key_id,
+        )
+    label_index = machines.label_index
+    if label_index is not None:
+        label_index = MachineLabelIndex(
+            kv_id=label_index.kv_id,
+            key_id=label_index.key_id,
+            kv_mask=label_index.kv_mask[idx],
+            key_mask=label_index.key_mask[idx],
+        )
+    return replace(
+        machines,
+        uuids=[machines.uuids[j] for j in cols],
+        cpu_capacity=machines.cpu_capacity[idx],
+        ram_capacity=machines.ram_capacity[idx],
+        cpu_used=machines.cpu_used[idx],
+        ram_used=machines.ram_used[idx],
+        cpu_util=machines.cpu_util[idx],
+        mem_util=machines.mem_util[idx],
+        slots_free=machines.slots_free[idx],
+        labels=[machines.labels[j] for j in cols],
+        net_rx_capacity=(
+            machines.net_rx_capacity[idx]
+            if machines.net_rx_capacity is not None else None
+        ),
+        net_rx_used=(
+            machines.net_rx_used[idx]
+            if machines.net_rx_used is not None else None
+        ),
+        type_census=(
+            machines.type_census[idx]
+            if machines.type_census is not None else None
+        ),
+        coco_penalties=(
+            machines.coco_penalties[idx]
+            if machines.coco_penalties is not None else None
+        ),
+        residents=residents,
+        label_index=label_index,
+        cpu_obs_used=(
+            machines.cpu_obs_used[idx]
+            if machines.cpu_obs_used is not None else None
+        ),
+        ram_obs_used=(
+            machines.ram_obs_used[idx]
+            if machines.ram_obs_used is not None else None
+        ),
+    )
 
 
 _REGISTRY: Dict[str, type] = {}

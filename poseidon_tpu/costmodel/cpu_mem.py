@@ -54,15 +54,44 @@ class CpuMemCostModel(base.CostModel):
     unsched_base: int = 2 * base.NORMALIZED_COST
     unsched_per_round: int = base.NORMALIZED_COST // 4
 
-    def build(
-        self, ecs: base.ECTable, machines: base.MachineTable
-    ) -> base.CostMatrices:
-        E, M = ecs.num_ecs, machines.num_machines
+    # Every cost/arc-capacity cell is a pure broadcastable function of
+    # (EC request/selectors/labels) x (machine capacity/usage/util/
+    # labels/residents) — the delta-plane cache's contract (and the
+    # reason this module forbids cross-cell arithmetic; see
+    # tests/test_cost_delta.py's oracle-parity suite).
+    delta_plane = True
+
+    def build_unsched(self, ecs: base.ECTable) -> np.ndarray:
+        """Per-EC unscheduled cost (the starvation escalator) — the one
+        ``build`` output that moves every round regardless of cost-plane
+        churn, so the delta cache recomputes it fresh."""
         unsched = (
             self.unsched_base
             + self.unsched_per_round * ecs.max_wait_rounds.astype(np.int64)
         )
-        unsched = np.clip(unsched, 0, 8 * base.NORMALIZED_COST).astype(np.int32)
+        return np.clip(
+            unsched, 0, 8 * base.NORMALIZED_COST
+        ).astype(np.int32)
+
+    def delta_col_arrays(self, machines: base.MachineTable):
+        """Machine-side cell inputs (fit, load pricing, blending);
+        slots_free feeds only the capacity VECTOR and is excluded."""
+        return [
+            ("cpu_capacity", machines.cpu_capacity),
+            ("ram_capacity", machines.ram_capacity),
+            ("cpu_used", machines.cpu_used),
+            ("ram_used", machines.ram_used),
+            ("cpu_util", machines.cpu_util),
+            ("mem_util", machines.mem_util),
+            ("cpu_obs_used", machines.cpu_obs_used),
+            ("ram_obs_used", machines.ram_obs_used),
+        ]
+
+    def build(
+        self, ecs: base.ECTable, machines: base.MachineTable
+    ) -> base.CostMatrices:
+        E, M = ecs.num_ecs, machines.num_machines
+        unsched = self.build_unsched(ecs)
         if E == 0 or M == 0:
             # No arcs to price, but the starvation escalator still applies
             # (a machineless round must not report zero unscheduled cost).
@@ -138,20 +167,41 @@ class CpuMemCostModel(base.CostModel):
             arc_cap = np.zeros((E, M), dtype=np.int32)
             arc_cap[rows, cols] = n_fit_v.astype(np.int32)
         else:
+            # Row dedup: every resource surface below depends on the EC
+            # row ONLY through (cpu_request, ram_request), and feature
+            # rounds carry hundreds of same-shape ECs (the 10k gang
+            # config: 501 rows, 2 shapes — ~1.3 s of float64 broadcasts
+            # for 2 distinct rows' worth of information).  Compute the
+            # [U, M] unique-shape surfaces once and GATHER: the same
+            # float64 ops in the same order produce each cell, so the
+            # result is bit-identical to the direct [E, M] build.
+            shape_u, shape_inv = np.unique(
+                np.stack([ecs.cpu_request, ecs.ram_request], axis=1),
+                axis=0, return_inverse=True,
+            )
+            dedup = 2 * shape_u.shape[0] <= E
+            if dedup:
+                cpu_req_d = shape_u[:, 0].astype(np.float64)[:, None]
+                ram_req_d = shape_u[:, 1].astype(np.float64)[:, None]
+            else:
+                cpu_req_d, ram_req_d = cpu_req, ram_req
             with np.errstate(divide="ignore", invalid="ignore"):
                 n_cpu = np.where(
-                    cpu_req > 0,
-                    np.floor(cpu_free / np.maximum(cpu_req, 1e-9)),
+                    cpu_req_d > 0,
+                    np.floor(cpu_free / np.maximum(cpu_req_d, 1e-9)),
                     np.inf,
                 )
                 n_ram = np.where(
-                    ram_req > 0,
-                    np.floor(ram_free / np.maximum(ram_req, 1e-9)),
+                    ram_req_d > 0,
+                    np.floor(ram_free / np.maximum(ram_req_d, 1e-9)),
                     np.inf,
                 )
             n_fit = np.minimum(n_cpu, n_ram)
             n_fit = np.where(np.isfinite(n_fit), n_fit, big_fit)
-            arc_cap = np.where(admissible, n_fit, 0).astype(np.int32)
+            n_fit_i = n_fit.astype(np.int32)
+            if dedup:
+                n_fit_i = n_fit_i[shape_inv]
+            arc_cap = np.where(admissible, n_fit_i, np.int32(0))
 
         # Anti-affinity to self = spreading: members of such an EC cannot
         # co-locate, so each machine takes at most one per round (running
@@ -198,14 +248,15 @@ class CpuMemCostModel(base.CostModel):
                 0, 4 * base.NORMALIZED_COST,
             ).astype(np.int32)
         else:
+            # Same unique-shape gather as the packing bound above.
             cpu_load = (
                 (1.0 - w)
-                * (cpu_committed[None, :] + cpu_req) / cpu_cap[None, :]
+                * (cpu_committed[None, :] + cpu_req_d) / cpu_cap[None, :]
                 + w * machines.cpu_util.astype(np.float64)[None, :]
             )
             mem_load = (
                 (1.0 - w)
-                * (ram_committed[None, :] + ram_req) / ram_cap[None, :]
+                * (ram_committed[None, :] + ram_req_d) / ram_cap[None, :]
                 + w * machines.mem_util.astype(np.float64)[None, :]
             )
             load = wc * cpu_load + (1.0 - wc) * mem_load
@@ -213,6 +264,8 @@ class CpuMemCostModel(base.CostModel):
                 np.rint(load * base.NORMALIZED_COST),
                 0, 4 * base.NORMALIZED_COST,
             ).astype(np.int32)
+            if dedup:
+                costs = costs[shape_inv]
             costs = np.where(admissible, costs, INF_COST).astype(np.int32)
 
         return base.CostMatrices(

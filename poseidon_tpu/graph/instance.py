@@ -100,6 +100,23 @@ class RoundMetrics:
     pruned_width: int = 0
     pruned_price_out_rounds: int = 0
     pruned_escalations: int = 0
+    # Reduced-plane certificate accepts (ops/transport_pruned.
+    # ExcludedColumnCert): pruned-band accepts certified by the
+    # incremental excluded-column bound instead of the full-plane
+    # O(E*M) lift + _certified_eps pass.
+    pruned_cert_accepts: int = 0
+    # Delta-maintained cost planes (costmodel/delta.py): band builds
+    # served incrementally this round, and the dirty row/column slices
+    # they rebuilt.  A steady-state churn round must show delta hits
+    # with small rebuild counts; zero hits on such a round means the
+    # incremental path silently fell back to full rebuilds.
+    cost_delta_hits: int = 0
+    cost_rows_rebuilt: int = 0
+    cost_cols_rebuilt: int = 0
+    # Seconds the cross-band pipeline's speculative cost build ran
+    # CONCURRENTLY with a band solve (graph/pipeline.py) — realized
+    # overlap, not submitted work.
+    pipeline_overlap_s: float = 0.0
     # Which tier of the degraded-mode ladder served the round (worst
     # band wins): "pruned" (shortlist + full-plane certificate),
     # "dense" (full-plane solve), "host_greedy" (the last-resort
@@ -208,41 +225,11 @@ def _remap_warm_state(w: _WarmState, ec_ids: List[int],
 
 
 def _slice_ecs(ecs, idx: np.ndarray):
-    """Row-sliced ECTable view for one band."""
-    from poseidon_tpu.costmodel.base import ECTable
+    """Row-sliced ECTable view for one band (the shared helper in
+    costmodel.base — the delta-plane cache slices with it too)."""
+    from poseidon_tpu.costmodel.base import slice_ecs
 
-    rows = idx.tolist()
-    return ECTable(
-        ec_ids=ecs.ec_ids[idx],
-        cpu_request=ecs.cpu_request[idx],
-        ram_request=ecs.ram_request[idx],
-        supply=ecs.supply[idx],
-        priority=ecs.priority[idx],
-        task_type=ecs.task_type[idx],
-        max_wait_rounds=ecs.max_wait_rounds[idx],
-        selectors=[ecs.selectors[i] for i in rows],
-        net_rx_request=(
-            ecs.net_rx_request[idx]
-            if ecs.net_rx_request is not None else None
-        ),
-        running_by_machine=(
-            ecs.running_by_machine[idx]
-            if ecs.running_by_machine is not None else None
-        ),
-        is_gang=ecs.is_gang[idx] if ecs.is_gang is not None else None,
-        pod_affinity=(
-            [ecs.pod_affinity[i] for i in rows]
-            if ecs.pod_affinity is not None else None
-        ),
-        pod_anti_affinity=(
-            [ecs.pod_anti_affinity[i] for i in rows]
-            if ecs.pod_anti_affinity is not None else None
-        ),
-        labels=(
-            [ecs.labels[i] for i in rows]
-            if ecs.labels is not None else None
-        ),
-    )
+    return slice_ecs(ecs, idx)
 
 
 def _column_caps(ecs_b, cm, mt, committed_cpu, committed_ram,
@@ -403,6 +390,24 @@ class RoundPlanner:
         self.incremental = incremental
         # Warm-start frames, one per size band (see _solve_banded).
         self._warm_bands: Dict[int, _WarmState] = {}
+        # Delta-maintained cost planes (costmodel/delta.py): per-band
+        # [E, M] cost/arc matrices patched in place from the round's
+        # dirty rows/columns, with the model's full build as the always-
+        # available oracle.  POSEIDON_COST_DELTA=0 is the escape hatch.
+        from poseidon_tpu.costmodel.delta import CostPlaneCache
+
+        self._plane_cache = CostPlaneCache(cost_model)
+        # Cross-band pipeline (graph/pipeline.py): speculative next-band
+        # cost builds on a single worker, overlapped with band solves.
+        self._cost_pipeline = None
+        # Last build's delta stats for the band currently being solved
+        # (consumed by the reduced-plane certificate cache).
+        self._last_build_stats: dict = self._plane_cache.last_stats
+        # Reduced-plane certificate caches and accepted-shortlist reuse,
+        # both per band (ops/transport_pruned.ExcludedColumnCert; the
+        # shortlist is stored as machine uuids so column churn remaps).
+        self._cert_bands: Dict[int, object] = {}
+        self._shortlist_bands: Dict[int, Tuple[List[str], int]] = {}
         # Per-round resubmission-affinity hint: per-EC arrays of prior
         # machine COLUMNS for pending members (consumed from
         # state.prior_machine each round; None when nothing matched).
@@ -420,6 +425,11 @@ class RoundPlanner:
         self._pruned_width = 0
         self._pruned_rounds = 0
         self._pruned_escalations = 0
+        self._cert_accepts = 0
+        self._cost_delta_hits = 0
+        self._cost_rows_rebuilt = 0
+        self._cost_cols_rebuilt = 0
+        self._pipeline_overlap = 0.0
         # Worst degraded-mode tier used this round (index into _TIERS).
         self._tier_rank = -1
         # Chaos seam (poseidon_tpu/chaos): when set, an object whose
@@ -681,6 +691,11 @@ class RoundPlanner:
                 pruned_width=metrics.pruned_width,
                 pruned_price_out_rounds=metrics.pruned_price_out_rounds,
                 pruned_escalations=metrics.pruned_escalations,
+                pruned_cert_accepts=metrics.pruned_cert_accepts,
+                cost_delta_hits=metrics.cost_delta_hits,
+                cost_rows_rebuilt=metrics.cost_rows_rebuilt,
+                cost_cols_rebuilt=metrics.cost_cols_rebuilt,
+                pipeline_overlap_s=metrics.pipeline_overlap_s,
                 converged=metrics.converged,
             )
         return deltas, metrics
@@ -1098,6 +1113,11 @@ class RoundPlanner:
         self._pruned_width = 0
         self._pruned_rounds = 0
         self._pruned_escalations = 0
+        self._cert_accepts = 0
+        self._cost_delta_hits = 0
+        self._cost_rows_rebuilt = 0
+        self._cost_cols_rebuilt = 0
+        self._pipeline_overlap = 0.0
         self._tier_rank = -1
         remaining = sorted(set(bands.tolist()))
         if len(remaining) > 1:
@@ -1108,6 +1128,7 @@ class RoundPlanner:
             )
             if chained is not None:
                 return chained
+        pipe = self._maybe_pipeline(len(remaining))
         while remaining:
             n_bands, idx = self._next_band_group(
                 remaining, bands, ecs, mt, committed_cpu, committed_ram,
@@ -1121,14 +1142,55 @@ class RoundPlanner:
                 np.maximum(base_slots - committed_slots, 0).astype(np.int32),
             )
             with _stage("round.cost_build"):
-                cm = self.cost_model.build(ecs_b, mt_b)
+                if pipe is not None:
+                    cm, build_stats = pipe.build(band, ecs_b, mt_b)
+                else:
+                    cm = self._plane_cache.build(band, ecs_b, mt_b)
+                    build_stats = self._plane_cache.last_stats
+            self._note_build_stats(build_stats)
 
             col_cap, net_req = _column_caps(
                 ecs_b, cm, mt, committed_cpu, committed_ram, committed_net
             )
 
+            if pipe is not None and remaining:
+                # Speculate band k+1's plane against the PRE-commit
+                # usage while this band solves: the authoritative build
+                # next iteration patches exactly the columns this band's
+                # flows dirty.  Usage arrays are copied here (frozen) —
+                # the live committed arrays keep mutating below.
+                _, idx_next = self._next_band_group(
+                    remaining, bands, ecs, mt, committed_cpu,
+                    committed_ram, committed_net,
+                )
+                if idx_next.size < 8:
+                    # A near-empty band rebuilds faster than the cache
+                    # can diff it (delta.MIN_ROWS declines it anyway) —
+                    # speculating would only add worker contention.
+                    idx_next = None
+            else:
+                idx_next = None
+            if idx_next is not None:
+                pipe.speculate(
+                    int(remaining[0]),
+                    _slice_ecs(ecs, idx_next),
+                    _with_usage(
+                        mt, committed_cpu.copy(), committed_ram.copy(),
+                        committed_net.copy(),
+                        np.maximum(
+                            base_slots - committed_slots, 0
+                        ).astype(np.int32),
+                    ),
+                    parent_span_id=self._round_span_id(),
+                )
+
+            t_band = time.perf_counter()
             with _stage("round.solve_band"):
                 sol = self._solve_band(band, ecs_b, cm, col_cap, mt.uuids)
+            if pipe is not None:
+                self._pipeline_overlap += pipe.overlap_with(
+                    t_band, time.perf_counter()
+                )
             objective += sol.objective
             gap = max(gap, sol.gap_bound)
             iters += sol.iterations
@@ -1156,9 +1218,46 @@ class RoundPlanner:
         metrics.pruned_width = self._pruned_width
         metrics.pruned_price_out_rounds = self._pruned_rounds
         metrics.pruned_escalations = self._pruned_escalations
+        metrics.pruned_cert_accepts = self._cert_accepts
+        metrics.cost_delta_hits = self._cost_delta_hits
+        metrics.cost_rows_rebuilt = self._cost_rows_rebuilt
+        metrics.cost_cols_rebuilt = self._cost_cols_rebuilt
+        metrics.pipeline_overlap_s = round(self._pipeline_overlap, 6)
         if self._tier_rank >= 0:
             metrics.solve_tier = self._TIERS[self._tier_rank]
         return flows_full
+
+    def _maybe_pipeline(self, n_bands: int):
+        """The cross-band pipeline, when it can pay: more than one band
+        group to ladder through, the delta plane cache live (a
+        speculative build must warm the cache, or joining it buys
+        nothing), and the env gate open."""
+        from poseidon_tpu.graph.pipeline import (
+            CostPipeline,
+            pipelining_enabled,
+        )
+
+        if (n_bands < 2 or not pipelining_enabled()
+                or not self._plane_cache.enabled()):
+            return None
+        if self._cost_pipeline is None:
+            self._cost_pipeline = CostPipeline(self._plane_cache)
+        return self._cost_pipeline
+
+    def _note_build_stats(self, stats: dict) -> None:
+        self._last_build_stats = stats
+        if stats.get("delta_hit"):
+            self._cost_delta_hits += 1
+            self._cost_rows_rebuilt += stats["rows_rebuilt"]
+            self._cost_cols_rebuilt += stats["cols_rebuilt"]
+
+    @staticmethod
+    def _round_span_id():
+        """Id of the innermost recorded span on this thread (the round
+        span during a solve), or None — the cross-thread parent for the
+        pipeline worker's spans."""
+        cur = _trace.current()
+        return getattr(cur, "id", None) or None
 
     def _try_chained_wave(self, ecs, mt, bands, remaining, committed_cpu,
                           committed_ram, committed_net, base_slots,
@@ -1426,7 +1525,8 @@ class RoundPlanner:
                 prices = flows0 = unsched0 = None
         warm_state = (prices, flows0, unsched0, eps_start)
 
-        out = self._try_pruned_band(ecs_b, cm, col_cap, warm_state)
+        out = self._try_pruned_band(band, ecs_b, cm, col_cap,
+                                    machine_uuids, warm_state)
         tier = "pruned"
         if out is None:
             out = self._solve_plane(
@@ -1467,7 +1567,8 @@ class RoundPlanner:
             self._warm_bands.pop(band, None)
         return sol
 
-    def _try_pruned_band(self, ecs_b, cm, col_cap, warm_state):
+    def _try_pruned_band(self, band, ecs_b, cm, col_cap, machine_uuids,
+                         warm_state):
         """Pruned-plane attempt (ops/transport_pruned): run the band's
         pipeline — coarse start, warm dispatch — on the union of
         per-row cheapest-column shortlists, certify the lifted solution
@@ -1494,19 +1595,41 @@ class RoundPlanner:
             self.gang_scheduling and ecs_b.is_gang is not None
             and bool(ecs_b.is_gang.any())
         )
+        # Reduced-plane certificate cache: fed the delta plane cache's
+        # dirty sets every build (the fold ledger), armed once the
+        # band's scale is known.  POSEIDON_CERT_CACHE=0 escape hatch.
+        ledger = self._plane_cache.take_ledger(band)
+        cert = None
+        if os.environ.get("POSEIDON_CERT_CACHE", "1") != "0":
+            cert = self._cert_bands.get(band)
+            if cert is None:
+                cert = self._cert_bands[band] = tp.ExcludedColumnCert()
+            cert.note_build(ecs_b.ec_ids, machine_uuids, ledger)
         eff_base = cm.costs
         warm = warm_state
         sol = None
         for attempt in range(int(ecs_b.is_gang.sum()) + 1 if repair else 1):
             prices, flows0, unsched0, eps_start = warm
             must = flows0.sum(axis=0) > 0 if flows0 is not None else None
-            plan = tp.plan_shortlist(
-                eff_base, ecs_b.supply, col_cap, cm.arc_capacity,
-                must_include=must,
+            plan = self._revive_shortlist(
+                band, ecs_b, col_cap, must, machine_uuids,
+                # A fresh plan owns heavy-churn rounds: revival is only
+                # a bet that last round's cheap columns are still the
+                # cheap columns, which the delta path's small dirty sets
+                # evidence — and which an in-round repair attempt
+                # (attempt > 0) gets for free from its own accept.
+                fresh_ok=(attempt > 0
+                          or bool(self._last_build_stats.get("delta_hit"))),
             )
+            if plan is None:
+                plan = tp.plan_shortlist(
+                    eff_base, ecs_b.supply, col_cap, cm.arc_capacity,
+                    must_include=must,
+                )
             if plan is None:
                 # Gate declined (round 0: never pruned; later: forbidden
                 # rows thinned the plane) — the dense path owns the band.
+                self._shortlist_bands.pop(band, None)
                 if attempt > 0:
                     self._pruned_escalations += 1
                 if sol is not None:
@@ -1525,6 +1648,11 @@ class RoundPlanner:
                     cm.costs, cm.unsched_cost, self.cost_model.max_cost(),
                     *padded_shape(E, M),
                 )
+                if cert is not None:
+                    # Arm the certificate cache: fold the deltas
+                    # accumulated since its last use against the BASE
+                    # plane at the band's pinned scale.
+                    cert.begin_attempt(cm.costs, scale_full)
 
             def solve_on(sel, warm_r, _eff=eff_base, _w=warm):
                 costs_r = np.ascontiguousarray(_eff[:, sel])
@@ -1552,7 +1680,7 @@ class RoundPlanner:
             sol, eff_full, stats = tp.solve_pruned(
                 eff_base, ecs_b.supply, col_cap, cm.unsched_cost,
                 arc_capacity=cm.arc_capacity, scale=scale_full, plan=plan,
-                solve_on=solve_on,
+                solve_on=solve_on, cert=cert,
             )
             self._pruned_width = max(self._pruned_width, stats["width"])
             self._pruned_rounds += stats["rounds"]
@@ -1560,6 +1688,7 @@ class RoundPlanner:
                 # Escalated attempts' device work must stay visible —
                 # the failed attempt's AND any accepted-then-abandoned
                 # earlier attempt's (the dense fallback starts over).
+                self._shortlist_bands.pop(band, None)
                 self._hidden_iters += stats["iterations"]
                 self._hidden_bf += stats["bf_sweeps"]
                 if prev is not None:
@@ -1572,6 +1701,17 @@ class RoundPlanner:
                 # dense repair loop.
                 self._hidden_iters += prev.iterations
                 self._hidden_bf += prev.bf_sweeps
+            if stats["sel"] is not None:
+                # The ACCEPTED union, keyed by machine uuid so column
+                # churn remaps next revival; saved per attempt so a
+                # repair re-solve revives this attempt's union instead
+                # of re-running the argpartition planner.
+                self._shortlist_bands[band] = (
+                    [machine_uuids[int(j)] for j in stats["sel"]],
+                    plan.k,
+                )
+            if stats["cert"] == "certified":
+                self._cert_accepts += 1
             if not repair:
                 break
             placed = sol.flows.sum(axis=1)
@@ -1591,6 +1731,66 @@ class RoundPlanner:
         # eff_full from the last accepted solve is eff_base itself (the
         # closure never forbids rows; repair forbids in the base).
         return sol, eff_full
+
+    def _revive_shortlist(self, band, ecs_b, col_cap, must,
+                          machine_uuids, fresh_ok):
+        """Revive the band's last ACCEPTED shortlist instead of
+        re-running the O(E*M) argpartition planner (plan_shortlist's
+        doubling + binary refine was ~2.0 s/round on the 10k gang
+        profile).  Sound for ANY column selection — every accept still
+        passes the reduced-plane or full-plane certificate and
+        violations grow the union through the price-out loop — so the
+        gates below are PERFORMANCE gates: the revived union must still
+        satisfy the planner's own size/capacity/width invariants, and
+        the plane must not have churned past the delta path
+        (``fresh_ok``).  Returns a ShortlistPlan or None (fresh plan)."""
+        if not fresh_ok:
+            return None
+        saved = self._shortlist_bands.get(band)
+        if saved is None:
+            return None
+        from poseidon_tpu.ops import transport_pruned as tp
+        from poseidon_tpu.ops.transport import bucket_size
+
+        uuids, k = saved
+        E = int(ecs_b.supply.size)
+        M = int(col_cap.size)
+        if (E < tp._env_int("POSEIDON_PRUNE_MIN_ROWS", tp.PRUNE_MIN_ROWS)
+                or M < tp._env_int("POSEIDON_PRUNE_MIN_COLS",
+                                   tp.PRUNE_MIN_COLS)):
+            return None
+        pos = {u: j for j, u in enumerate(machine_uuids)}
+        cols = [pos[u] for u in uuids if u in pos]
+        if len(cols) * 32 < len(uuids) * 31:
+            # >~3% of the union's machines left the cluster: the saved
+            # cheap-column structure is suspect, replan.
+            return None
+        mask = np.zeros(M, dtype=bool)
+        mask[np.asarray(cols, dtype=np.int64)] = True
+        if must is not None:
+            mask |= must
+        cap64 = col_cap.astype(np.int64)
+        total_supply = int(ecs_b.supply.astype(np.int64).sum())
+        if total_supply <= 0:
+            return None
+        if int(cap64[mask].sum()) < tp.PRUNE_SLACK * total_supply:
+            return None  # churn ate the union's capacity slack
+        width_cap = (M * tp.PRUNE_MAX_WIDTH_NUM
+                     // tp.PRUNE_MAX_WIDTH_DEN)
+        width = int(mask.sum())
+        if width > width_cap:
+            return None
+        target = bucket_size(width, lo=32)
+        if target > width_cap:
+            return None
+        if target > width:
+            # Pad to the compile-key bucket with unselected live
+            # columns, largest free capacity first (deterministic, and
+            # spare capacity is what a revived union most often lost).
+            free = np.nonzero(~mask)[0]
+            order = free[np.argsort(-cap64[free], kind="stable")]
+            mask[order[: target - width]] = True
+        return tp.ShortlistPlan(sel=np.nonzero(mask)[0], k=k)
 
     def _solve_plane(self, ecs_b, costs, col_cap, arc_capacity,
                      unsched_cost, warm_state, scale=None,

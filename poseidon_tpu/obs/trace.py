@@ -70,16 +70,18 @@ class Span:
     """One open span; finished spans become plain dicts in the buffer."""
 
     __slots__ = ("_tracer", "name", "attrs", "_record", "_t0",
-                 "_parent_id", "id")
+                 "_parent_id", "_explicit_parent", "id")
 
     def __init__(self, tracer: "Tracer", name: str, attrs: Dict[str, Any],
-                 record: bool) -> None:
+                 record: bool,
+                 explicit_parent: Optional[int] = None) -> None:
         self._tracer = tracer
         self.name = name
         self.attrs = attrs
         self._record = record
         self._t0 = 0.0
         self._parent_id: Optional[int] = None
+        self._explicit_parent = explicit_parent
         self.id = 0
 
     def set(self, **attrs) -> "Span":
@@ -90,7 +92,13 @@ class Span:
     def __enter__(self) -> "Span":
         if self._record:
             stack = self._tracer._stack()
-            self._parent_id = stack[-1].id if stack else None
+            if self._explicit_parent is not None:
+                # Cross-thread parenting (the pipelined cost build: a
+                # worker-lane span whose logical parent — the round —
+                # lives on the planner thread's stack).
+                self._parent_id = self._explicit_parent
+            else:
+                self._parent_id = stack[-1].id if stack else None
             self.id = next(_ids)
             stack.append(self)
         self._t0 = time.perf_counter()
@@ -161,12 +169,16 @@ class Tracer:
 
     # ------------------------------------------------------------------ spans
 
-    def span(self, name: str, **attrs):
+    def span(self, name: str, parent: Optional[int] = None, **attrs):
+        """``parent`` (a span id) overrides the per-thread stack parent
+        — used by worker-thread spans whose logical parent lives on
+        another thread's stack."""
         if self.force is None and TRACE_ENV not in os.environ \
                 and STAGE_ENV not in os.environ:
             return NULL_SPAN  # the common (fully disabled) fast path
         if self.tracing():
-            return Span(self, name, attrs, record=True)
+            return Span(self, name, attrs, record=True,
+                        explicit_parent=parent)
         if os.environ.get(STAGE_ENV) == "1":
             return Span(self, name, attrs, record=False)
         return NULL_SPAN
@@ -289,9 +301,14 @@ def validate_chrome_trace(obj: dict) -> List[str]:
     list of problems (empty = Perfetto-loadable by this format's rules).
 
     Checks: JSON-serializability, required complete-event fields, and —
-    the property the timeline view depends on — that same-thread spans
+    the property the timeline view depends on — that SAME-LANE spans
     are properly NESTED (a child interval lies within its enclosing
-    span, never partially overlapping it).
+    span, never partially overlapping it).  Spans on DIFFERENT lanes may
+    overlap freely (the pipelined round: band k's solve on the planner
+    lane runs while band k+1's cost build runs on the worker lane), but
+    the explicit ``parent_id`` links must still contain their children
+    in time — a cross-thread child escaping its parent's interval is a
+    bookkeeping bug, not concurrency.
     """
     problems: List[str] = []
     try:
@@ -303,6 +320,8 @@ def validate_chrome_trace(obj: dict) -> List[str]:
     if not isinstance(events, list):
         return ["traceEvents is not a list"]
     lanes: Dict[Tuple[int, int], List[Tuple[int, int, str]]] = {}
+    by_span_id: Dict[int, Tuple[int, int, str]] = {}
+    linked: List[Tuple[int, int, str, int]] = []
     for i, e in enumerate(events):
         ph = e.get("ph")
         if ph == "M":
@@ -323,6 +342,30 @@ def validate_chrome_trace(obj: dict) -> List[str]:
         lanes.setdefault((e.get("pid", 0), e.get("tid", 0)), []).append(
             (ts, dur, e.get("name", "?"))
         )
+        args = e.get("args", {})
+        sid = args.get("span_id")
+        if isinstance(sid, int):
+            by_span_id[sid] = (ts, dur, e.get("name", "?"))
+        pid_arg = args.get("parent_id")
+        if isinstance(pid_arg, int):
+            linked.append((ts, dur, e.get("name", "?"), pid_arg))
+    # Explicit parent links (lane-independent): a child must lie inside
+    # its parent's interval.  2 us slop — BOTH exported durations are
+    # floored at 1 us, so an instant child of an instant parent can
+    # overshoot by up to two ticks.
+    for ts, dur, name, parent in linked:
+        got = by_span_id.get(parent)
+        if got is None:
+            problems.append(
+                f"span {name!r} references unknown parent_id {parent}"
+            )
+            continue
+        p_ts, p_dur, p_name = got
+        if ts < p_ts or ts + dur > p_ts + p_dur + 2:
+            problems.append(
+                f"span {name!r} [{ts},{ts + dur}) escapes its parent "
+                f"{p_name!r} [{p_ts},{p_ts + p_dur})"
+            )
     for (pid, tid), lane in sorted(lanes.items()):
         lane.sort(key=lambda t: (t[0], -t[1]))
         stack: List[Tuple[int, int, str]] = []
@@ -360,9 +403,9 @@ def tracer() -> Tracer:
     return _TRACER
 
 
-def span(name: str, **attrs):
+def span(name: str, parent: Optional[int] = None, **attrs):
     """Open a span on the process tracer (context manager)."""
-    return _TRACER.span(name, **attrs)
+    return _TRACER.span(name, parent=parent, **attrs)
 
 
 def current():

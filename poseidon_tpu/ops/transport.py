@@ -2077,7 +2077,8 @@ def solve_transport(
     )
 
 
-def _lift_excluded_prices(pe, pm_sel, pt, sel, *, costs, capacity, scale):
+def _lift_excluded_prices(pe, pm_sel, pt, sel, *, costs, capacity, scale,
+                          min_e=None):
     """Potentials for columns excluded from a reduced solve.
 
     An excluded column carries no flow, so its potential only has to keep
@@ -2088,13 +2089,20 @@ def _lift_excluded_prices(pe, pm_sel, pt, sel, *, costs, capacity, scale):
     attractive and the full certificate flags it (-> full-solve
     fallback).  Vectorized over all M columns; the selected entries are
     then overwritten with the solver's own potentials.
+
+    ``min_e`` lets a caller that already computed the per-column
+    admissible minimum of ``C * scale + pe`` (the pruned path's
+    certificate cache refreshes from the same pass) hand it in instead
+    of paying the O(E*M) reduction twice.
     """
     E, M = costs.shape
-    C = costs.astype(np.int64) * scale
-    cand = np.where(
-        costs < INF_COST, C + pe.astype(np.int64)[:, None], np.int64(_POS)
-    )
-    min_e = cand.min(axis=0)                      # [M]
+    if min_e is None:
+        C = costs.astype(np.int64) * scale
+        cand = np.where(
+            costs < INF_COST, C + pe.astype(np.int64)[:, None],
+            np.int64(_POS),
+        )
+        min_e = cand.min(axis=0)                  # [M]
     pm = np.maximum(min_e, pt - 1)
     pm = np.where(min_e >= _POS, pt, pm)          # no admissible arcs
     pm = np.where(capacity > 0, pm, 0)            # dead columns are inert

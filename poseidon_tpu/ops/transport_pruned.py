@@ -37,10 +37,11 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass
-from typing import Callable, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
+from poseidon_tpu.utils.envutil import env_int as _env_int
 from poseidon_tpu.ops.transport import (
     INF_COST,
     TransportSolution,
@@ -74,13 +75,6 @@ PRUNE_MAX_WIDTH_DEN = 2
 # re-solve rounds before escalating to the dense path.
 PRICE_OUT_TOP_J = 8
 PRICE_OUT_MAX_ROUNDS = 3
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
 
 
 @dataclass
@@ -136,53 +130,74 @@ def plan_shortlist(
     if must_include is not None:
         base_mask |= must_include
     work = np.where(adm, costs, INF_COST)
-    rows_ix = np.arange(E)[:, None]
 
-    def union_for(k):
-        mask = base_mask.copy()
-        if k >= M:
-            mask |= adm.any(axis=0)
-            return mask
-        part = np.argpartition(work, k - 1, axis=1)[:, :k]
-        # Only admissible cells select their column: an inadmissible
-        # cell would add capacity no row in the shortlist can use.
-        sel_cells = adm[rows_ix, part]
-        mask[part[sel_cells]] = True
-        return mask
+    # One argpartition + per-row sorted prefix, then the minimal
+    # covering k DIRECTLY: a column joins the union at prefix position
+    # ``first_pos[m] = min over rows of its rank in that row's sorted
+    # shortlist``, so the smallest k whose union capacity covers the
+    # slack target falls out of one cumulative-capacity scan over
+    # columns ordered by first_pos — no probing.  (The old doubling +
+    # 12-step binary refine re-partitioned the full plane per probe:
+    # ~22 O(E*M) passes, 1.8 s of the 10k gang round's host time, for
+    # the same k this computes exactly.)
+    prefix = {"k": 0, "cols": None, "adm": None}
 
+    def _grow_prefix(k):
+        kk = min(M, max(k, 64))
+        part = np.argpartition(work, kk - 1, axis=1)[:, :kk]
+        vals = np.take_along_axis(work, part, axis=1)
+        order = np.argsort(vals, axis=1, kind="stable")
+        prefix["cols"] = np.take_along_axis(part, order, axis=1)
+        prefix["adm"] = np.take_along_axis(vals, order, axis=1) < INF_COST
+        prefix["k"] = kk
+
+    pos_cap = cap64[cap64 > 0]
+    med_cap = int(np.median(pos_cap)) if pos_cap.size else 1
     if k0 is None:
         # Start from what a row actually needs — enough columns at the
         # median column capacity to hold its own supply, plus margin.
         # A fixed k0 makes the union E*k0 wide under diverse costs (rows
         # share nothing), overshooting the width cap before capacity
         # coverage ever gets a say.
-        pos_cap = cap64[cap64 > 0]
-        med_cap = int(np.median(pos_cap)) if pos_cap.size else 1
         k0 = int(np.ceil(int(supply.max(initial=1)) / max(med_cap, 1))) + 2
     k = max(4, min(k0, M))
     need = slack * total_supply
-    k_lo = 0
-    mask = union_for(k)
-    while int(cap64[mask].sum()) < need:
-        if k >= M:
-            return None  # even the full admissible union can't cover
-        k_lo = k
-        k = min(2 * k, M)
-        mask = union_for(k)
-    # Binary-refine to the smallest covering k: the doubling can overshoot
-    # by almost 2x, and under tied costs the union tracks k directly, so
-    # an overshoot turns a viable reduction (e.g. 4000 of 10000 columns)
-    # into a width-cap decline.  Monotone in k; a dozen O(E*M) partition
-    # passes, trivial next to the solve work the reduction saves.
-    for _ in range(12):
-        if k - k_lo <= 1:
-            break
-        mid = (k + k_lo) // 2
-        cand = union_for(mid)
-        if int(cap64[cand].sum()) >= need:
-            k, mask = mid, cand
-        else:
-            k_lo = mid
+    # Prefix width guess: under fully tied costs the union tracks k
+    # directly, so coverage needs ~need/med_cap columns per row; the
+    # loop regrows (rare) when admissibility holes push k past it.
+    _grow_prefix(min(M, max(
+        64, 2 * k, int(np.ceil(need / max(med_cap, 1))) + 64,
+    )))
+    sentinel = np.int64(M) + 1
+    while True:
+        K = prefix["k"]
+        first_pos = np.full(M, sentinel, dtype=np.int64)
+        jj = np.broadcast_to(
+            np.arange(K, dtype=np.int64), prefix["cols"].shape
+        )
+        a = prefix["adm"]
+        # Only admissible cells select their column: an inadmissible
+        # cell would add capacity no row in the shortlist can use.
+        np.minimum.at(first_pos, prefix["cols"][a], jj[a])
+        first_pos[base_mask] = -1
+        order = np.argsort(first_pos, kind="stable")
+        cum = np.cumsum(
+            np.where(first_pos < sentinel, cap64, 0)[order]
+        )
+        if cum.size == 0 or int(cum[-1]) < need:
+            if K >= M:
+                return None  # even the full admissible union can't cover
+            _grow_prefix(2 * K)
+            continue
+        idx = int(np.searchsorted(cum, need))
+        fp = int(first_pos[order[idx]])
+        if fp >= K and K < M:
+            # Coverage only closes beyond the prefix: regrow and redo.
+            _grow_prefix(2 * K)
+            continue
+        mask = base_mask | (first_pos <= fp)
+        k = max(fp + 1, 1)
+        break
     width = int(mask.sum())
     if width > width_cap:
         return None
@@ -201,6 +216,328 @@ def plan_shortlist(
     return ShortlistPlan(sel=np.nonzero(mask)[0], k=k)
 
 
+_POS64 = np.int64(1) << 60
+
+
+class ExcludedColumnCert:
+    """Incremental excluded-column certificate: the reduced-plane accept
+    without the full-plane O(E*M) pass.
+
+    The pruned accept's only full-plane work is proving that every
+    EXCLUDED column prices out clean — equivalently (see
+    ``_lift_excluded_prices``) that each excluded column m satisfies
+    ``min over open arcs of (C[e,m]*scale + pe[e]) >= pt - 2``.  This
+    cache maintains, per band, a sound per-column LOWER BOUND on that
+    minimum — ``floor[m] <= min over stable rows of (C*scale + pe_ref)``
+    for a reference price vector ``pe_ref`` captured at the last full
+    certification — and each round certifies excluded columns by
+
+        ``floor[m] - shift >= pt - 1``   (then ``pm = pt`` is 1-optimal),
+
+    where ``shift = max(pe_ref - pe_now)`` over the stable rows.
+    Columns failing the bound are re-checked EXACTLY (a gathered
+    O(E * |candidates|) pass that reproduces the lift's accept boundary
+    bit-for-bit); genuine violations feed the existing price-out
+    escalation.  The caller certifies the INCLUDED plane through the
+    reduced solve's own certificate, so an accepted round touches no
+    full-plane host work at all.
+
+    Soundness upkeep (fold-only, so the bound can sag but never lie):
+
+    - the planner's delta plane cache reports, per band build, exactly
+      which rows/columns changed (``note_build``); their CURRENT cell
+      values are folded into ``floor`` with ``min`` before the next
+      check — intermediate values a check never saw don't matter;
+    - rows are trusted only while STABLE (present in every build since
+      the reference): a row that leaves and returns may have missed a
+      column fold while absent, so it drops to the exact path until the
+      next refresh re-anchors it;
+    - a full plane rebuild (unknown changes), a scale change, or a
+      fold/exact set grown past its gate invalidates the cache; the
+      caller then runs the classic full pass, whose lift already
+      computes the per-column minima this cache refreshes from — a
+      refresh round costs nothing extra.
+    """
+
+    # Unstable + new rows past this fraction of E are declared
+    # inconclusive at arm time (their exact block approaches the full
+    # plane's O(E*M)); bound-failing COLUMNS carry no such cap — their
+    # exact re-check is O(E * cand) <= O(E * excluded), always cheaper
+    # than the classic full pass it replaces, and at the solver's
+    # normalized equilibrium (uniform-cost gang planes) every excluded
+    # minimum sits exactly at pt - 1, so a zero-margin bound flagging
+    # every column is the NORMAL case, not a degenerate one.
+    ROW_FRAC_NUM = 1
+    ROW_FRAC_DEN = 4
+
+    def __init__(self) -> None:
+        self.invalidate()
+
+    def invalidate(self) -> None:
+        self._scale: Optional[int] = None
+        self._ec_pos: dict = {}
+        self._pe_ref: Optional[np.ndarray] = None
+        self._uuid_pos: dict = {}
+        self._floor: Optional[np.ndarray] = None
+        self._stable: Optional[np.ndarray] = None   # bool over ref rows
+        # Dirty row/column IDS accumulated from plane builds since the
+        # last fold (deferred: folding needs costs + scale, which only
+        # the firing pruned path has).
+        self._pending_rows: set = set()
+        self._pending_cols: set = set()
+        self._broken = True
+        # Per-round prepared state (begin_round):
+        self._ready = False
+        self._cur_ref_row: Optional[np.ndarray] = None
+        self._exact_rows: Optional[np.ndarray] = None
+        self._floor_cur: Optional[np.ndarray] = None
+        self._trusted_rows: Optional[np.ndarray] = None
+        self._cur_ec_ids = None
+        self._cur_uuids = None
+
+    @property
+    def ready(self) -> bool:
+        return self._ready
+
+    # ------------------------------------------------------------ bookkeeping
+
+    def note_build(self, ec_ids, uuids, ledger) -> None:
+        """Consume the plane cache's accumulated dirty ledger for this
+        band (costmodel/delta.PlaneLedger) — the UNION of every build's
+        dirty rows/columns since the last consume, speculative pipeline
+        builds included.  ``ledger`` is None when no cache build was
+        recorded since the last take: the chain is broken (an unseen
+        plane replaced the one the floors describe)."""
+        self._cur_ec_ids = np.asarray(ec_ids, dtype=np.uint64)
+        self._cur_uuids = list(uuids)
+        self._ready = False
+        if self._floor is None:
+            return
+        if ledger is None or ledger.broken:
+            self._broken = True
+            return
+        if ledger.present is not None:
+            # Stability: a ref row absent from ANY build since the last
+            # consume may have missed a column fold; drop it from the
+            # trusted set until the next refresh re-anchors it.
+            present = np.zeros(len(self._ec_pos), dtype=bool)
+            for e in ledger.present:
+                j = self._ec_pos.get(int(e))
+                if j is not None:
+                    present[j] = True
+            self._stable &= present
+        self._pending_rows.update(ledger.rows)
+        self._pending_cols.update(ledger.cols)
+        # A pending set this large means churn outran the cache; give
+        # up and let the next full pass re-anchor (bounded memory).
+        if (len(self._pending_rows) > 4 * len(self._ec_pos)
+                or len(self._pending_cols) > len(self._uuid_pos)):
+            self._broken = True
+
+    def begin_attempt(self, costs: np.ndarray, scale: int) -> bool:
+        """Fold the pending deltas against the CURRENT costs and prepare
+        per-round state; returns usability.  ``costs`` is the band's
+        BASE cost plane (gang-forbidden rows are handled by the eff >=
+        base superset argument at check time)."""
+        self._ready = False
+        if (self._broken or self._floor is None
+                or self._cur_ec_ids is None
+                or scale != self._scale):
+            return False
+        E = self._cur_ec_ids.shape[0]
+        M = len(self._cur_uuids)
+        if costs.shape != (E, M):
+            return False
+        cur_ref = np.asarray(
+            [self._ec_pos.get(int(e), -1) for e in self._cur_ec_ids],
+            dtype=np.int64,
+        )
+        trusted = (cur_ref >= 0) & self._stable[np.clip(cur_ref, 0, None)]
+        exact_rows = np.nonzero(~trusted)[0]
+        if exact_rows.size * self.ROW_FRAC_DEN > E * self.ROW_FRAC_NUM:
+            return False
+        col_ref = np.asarray(
+            [self._uuid_pos.get(u, -1) for u in self._cur_uuids],
+            dtype=np.int64,
+        )
+        trust_rows = np.nonzero(trusted)[0]
+        pe_ref_cur = np.zeros(E, dtype=np.int64)
+        pe_ref_cur[trust_rows] = self._pe_ref[cur_ref[trust_rows]]
+
+        def col_min(cols: np.ndarray) -> np.ndarray:
+            """min over trusted rows of (C*scale + pe_ref), by column."""
+            if trust_rows.size == 0 or cols.size == 0:
+                return np.full(cols.size, _POS64, dtype=np.int64)
+            sub = costs[np.ix_(trust_rows, cols)]
+            val = np.where(
+                sub < INF_COST,
+                sub.astype(np.int64) * scale
+                + pe_ref_cur[trust_rows][:, None],
+                _POS64,
+            )
+            return val.min(axis=0)
+
+        # Fold pending dirty rows (trusted ones: their current cells may
+        # undercut the stored floor anywhere).
+        fold_rows = [
+            i for i in trust_rows.tolist()
+            if int(self._cur_ec_ids[i]) in self._pending_rows
+        ]
+        if fold_rows:
+            have = np.nonzero(col_ref >= 0)[0]
+            sub = costs[np.ix_(np.asarray(fold_rows, dtype=np.int64),
+                               have)]
+            val = np.where(
+                sub < INF_COST,
+                sub.astype(np.int64) * scale
+                + pe_ref_cur[np.asarray(fold_rows)][:, None],
+                _POS64,
+            )
+            np.minimum.at(self._floor, col_ref[have], val.min(axis=0))
+        # Fold pending dirty columns and mint floors for new columns
+        # (exact over the trusted rows — sound by construction, and a
+        # returning column self-heals here).
+        fold_cols = np.asarray(
+            [j for j in range(M)
+             if col_ref[j] < 0 or self._cur_uuids[j] in self._pending_cols],
+            dtype=np.int64,
+        )
+        if fold_cols.size:
+            fresh = col_min(fold_cols)
+            minted: List[int] = []
+            for k, j in enumerate(fold_cols.tolist()):
+                u = self._cur_uuids[j]
+                p = self._uuid_pos.get(u)
+                if p is None:
+                    p = self._floor.shape[0] + len(minted)
+                    self._uuid_pos[u] = p
+                    minted.append(int(fresh[k]))
+                    col_ref[j] = p
+                else:
+                    self._floor[p] = min(int(self._floor[p]),
+                                         int(fresh[k]))
+            if minted:
+                self._floor = np.concatenate(
+                    [self._floor, np.asarray(minted, dtype=np.int64)]
+                )
+        self._pending_rows.clear()
+        self._pending_cols.clear()
+        self._cur_ref_row = cur_ref
+        self._exact_rows = exact_rows
+        self._floor_cur = self._floor[col_ref]
+        self._trusted_rows = trust_rows
+        self._ready = True
+        return True
+
+    # ----------------------------------------------------------------- check
+
+    def check(self, *, eff_costs, pe, pt, supply, capacity, arc_capacity,
+              scale, mask):
+        """Certify the excluded columns under current prices.  Returns
+        ``(status, viol_cols, worst, pm_excluded)`` with status one of
+        ``"certified"`` / ``"violations"`` / ``"inconclusive"``.
+        ``pm_excluded`` (int64 [M], excluded entries valid) reproduces
+        the lift's potentials: ``pt`` for bound-certified columns,
+        ``max(min_adm, pt - 1)`` for exactly-checked ones."""
+        if not self._ready or scale != self._scale:
+            return "inconclusive", None, 0, None
+        E, M = eff_costs.shape
+        pe64 = np.asarray(pe, dtype=np.int64)
+        excluded = np.nonzero(~mask)[0]
+        pm = np.full(M, int(pt), dtype=np.int64)
+        pm[np.asarray(capacity, dtype=np.int64) <= 0] = 0  # inert (lift)
+        if excluded.size == 0:
+            return "certified", None, 0, pm
+        tr = self._trusted_rows
+        ex_rows = self._exact_rows
+        shift = 0
+        if tr.size:
+            drift = self._pe_ref[self._cur_ref_row[tr]] - pe64[tr]
+            shift = max(0, int(drift.max()))
+            if shift > 2:
+                # A handful of heavy drifters (gang-repair forbidden
+                # rows whose pe collapses on the re-solve) would drag
+                # the bound down for EVERY column; demote them to the
+                # exact path and keep the bound tight for the rest.
+                # Sound: the bound only needs to cover the rows the
+                # exact pass does not, and ``floor`` is a lower bound
+                # for any subset's minimum.
+                keep = max(1, tr.size - max(8, tr.size // 32))
+                part = np.partition(drift, keep - 1)
+                cut = max(int(part[keep - 1]), 2)
+                heavy = drift > cut
+                if heavy.any():
+                    ex_rows = np.union1d(ex_rows, tr[heavy])
+                    shift = max(0, int(drift[~heavy].max()))
+        bound = self._floor_cur[excluded] - shift
+        if ex_rows.size:
+            sub = eff_costs[np.ix_(ex_rows, excluded)]
+            val = np.where(
+                sub < INF_COST,
+                sub.astype(np.int64) * scale + pe64[ex_rows][:, None],
+                _POS64,
+            )
+            bound = np.minimum(bound, val.min(axis=0))
+        cand = excluded[bound < pt - 1]
+        if cand.size == 0:
+            return "certified", None, 0, pm
+        # Exact pass over the failing columns: reproduces the full
+        # lift + certificate boundary (open-arc minimum vs pt - 2).
+        sub = eff_costs[:, cand]
+        adm = sub < INF_COST
+        val = np.where(
+            adm, sub.astype(np.int64) * scale + pe64[:, None], _POS64
+        )
+        min_adm = val.min(axis=0)
+        open_ = adm & (supply.astype(np.int64)[:, None] > 0)
+        open_ &= capacity.astype(np.int64)[cand][None, :] > 0
+        if arc_capacity is not None:
+            open_ &= arc_capacity[:, cand].astype(np.int64) > 0
+        min_open = np.where(open_, val, _POS64).min(axis=0)
+        dead = capacity.astype(np.int64)[cand] <= 0
+        ok = dead | (min_open >= pt - 2)
+        # The lift's exact potentials: max(min_adm, pt-1), pt when the
+        # column has no admissible arcs, 0 when it has no sink capacity.
+        pm_cand = np.maximum(min_adm, pt - 1)
+        pm_cand = np.where(min_adm >= _POS64, pt, pm_cand)
+        pm[cand] = np.where(dead, 0, pm_cand)
+        if ok.all():
+            return "certified", None, 0, pm
+        viol = cand[~ok]
+        worst = int((pt - 1 - min_open[~ok]).max())
+        return "violations", viol, worst, pm
+
+    # --------------------------------------------------------------- refresh
+
+    def refresh(self, *, scale: int, pe: np.ndarray,
+                min_e: np.ndarray) -> None:
+        """Re-anchor from a full certification pass: ``min_e`` is the
+        per-column admissible minimum of ``C*scale + pe`` over the BASE
+        costs (the lift computes it anyway)."""
+        if self._cur_ec_ids is None:
+            return
+        self._scale = int(scale)
+        self._ec_pos = {
+            int(e): i for i, e in enumerate(self._cur_ec_ids)
+        }
+        self._pe_ref = np.asarray(pe, dtype=np.int64).copy()
+        self._uuid_pos = {u: j for j, u in enumerate(self._cur_uuids)}
+        self._floor = np.asarray(min_e, dtype=np.int64).copy()
+        self._stable = np.ones(len(self._ec_pos), dtype=bool)
+        self._pending_rows.clear()
+        self._pending_cols.clear()
+        self._broken = False
+        self._ready = False  # begin_attempt re-prepares (same round ok)
+        # Prepared state for an immediate same-round re-check (gang
+        # repair attempts): everything matches the frame just stored.
+        E = len(self._ec_pos)
+        self._cur_ref_row = np.arange(E, dtype=np.int64)
+        self._exact_rows = np.zeros(0, dtype=np.int64)
+        self._floor_cur = self._floor.copy()
+        self._trusted_rows = np.arange(E, dtype=np.int64)
+        self._ready = True
+
+
 def scatter_flows(sel: np.ndarray, flows_r: np.ndarray, M: int) -> np.ndarray:
     """Reduced [E, W] flows -> full [E, M] (excluded columns zero)."""
     E = flows_r.shape[0]
@@ -210,19 +547,31 @@ def scatter_flows(sel: np.ndarray, flows_r: np.ndarray, M: int) -> np.ndarray:
 
 
 def lift_prices(sel: np.ndarray, prices_r: np.ndarray, *, costs: np.ndarray,
-                capacity: np.ndarray, scale: int) -> np.ndarray:
+                capacity: np.ndarray, scale: int,
+                with_min_e: bool = False):
     """Reduced prices -> full-plane prices, excluded columns priced by the
-    conservative residual-arc lift (transport._lift_excluded_prices)."""
+    conservative residual-arc lift (transport._lift_excluded_prices).
+    ``with_min_e=True`` also returns the per-column admissible minimum of
+    ``C*scale + pe`` the lift derives from — the certificate cache's
+    refresh input (one O(E*M) pass instead of two)."""
     E, M = costs.shape
     pe = prices_r[:E]
     pt = int(prices_r[E + sel.size])
+    min_e = np.where(
+        costs < INF_COST,
+        costs.astype(np.int64) * scale + pe.astype(np.int64)[:, None],
+        _POS64,
+    ).min(axis=0)
     pm = _lift_excluded_prices(
         pe, prices_r[E:E + sel.size].astype(np.int64), pt, sel,
-        costs=costs, capacity=capacity, scale=scale,
+        costs=costs, capacity=capacity, scale=scale, min_e=min_e,
     )
-    return np.concatenate(
+    prices = np.concatenate(
         [pe.astype(np.int64), pm, np.int64([pt])]
     ).astype(np.int64)
+    if with_min_e:
+        return prices, min_e
+    return prices
 
 
 def price_out_violations(
@@ -295,6 +644,7 @@ def solve_pruned(
     max_rounds: Optional[int] = None,
     top_j: Optional[int] = None,
     plan_kw: Optional[dict] = None,
+    cert: Optional[ExcludedColumnCert] = None,
 ) -> Tuple[Optional[TransportSolution], Optional[np.ndarray], dict]:
     """The pruned-plane driver: shortlist -> solve -> price-out loop.
 
@@ -319,7 +669,8 @@ def solve_pruned(
     unsched_cost = np.asarray(unsched_cost, dtype=np.int32)
     E, M = costs.shape
     stats = {"width": 0, "rounds": 0, "escalated": False,
-             "declined": False, "iterations": 0, "bf_sweeps": 0}
+             "declined": False, "iterations": 0, "bf_sweeps": 0,
+             "cert": "off", "sel": None}
     if plan is None:
         plan = plan_shortlist(costs, supply, capacity, arc_capacity,
                               **(plan_kw or {}))
@@ -372,15 +723,11 @@ def solve_pruned(
         else:
             eff_full = costs
         flows_full = scatter_flows(sel, sol_r.flows, M)
-        prices_full = lift_prices(sel, sol_r.prices, costs=eff_full,
-                                  capacity=capacity, scale=scale)
-        eps_full = _certified_eps(
-            flows_full, sol_r.unsched, prices_full, costs=eff_full,
-            supply=supply, capacity=capacity, unsched_cost=unsched_cost,
-            scale=scale, arc_capacity=arc_capacity,
-        )
-        if eps_full <= 1:
-            n = E + M + 3
+        n = E + M + 3
+        pe_now = sol_r.prices[:E].astype(np.int64)
+        pt_now = int(sol_r.prices[E + sel.size])
+
+        def accept(prices_full):
             sol = TransportSolution(
                 flows=flows_full,
                 unsched=sol_r.unsched.copy(),
@@ -391,13 +738,76 @@ def solve_pruned(
                 bf_sweeps=bf,
                 phase_iters=sol_r.phase_iters,
             )
+            stats["sel"] = sel
             return sol, eff_full, stats
+
+        # Reduced-plane certificate: the included plane is certified by
+        # the reduced solve itself (the gap accept above); the excluded
+        # columns go through the incremental bound + exact-candidate
+        # pass — same accept boundary as the classic full-plane lift +
+        # _certified_eps, without the O(E*M) work.  Inconclusive rounds
+        # (stale floors, heavy churn) fall through to the full pass,
+        # which re-anchors the cache for free.
+        add_cols = worst = None
+        if cert is not None and cert.ready:
+            status, viol, worst_c, pm_exc = cert.check(
+                eff_costs=eff_full, pe=pe_now, pt=pt_now, supply=supply,
+                capacity=capacity, arc_capacity=arc_capacity,
+                scale=scale, mask=mask,
+            )
+            stats["cert"] = status
+            if status in ("certified", "violations"):
+                pm_exc = np.clip(pm_exc, -(1 << 30) // 2, 1 << 30)
+                pm_exc[sel] = sol_r.prices[E:E + sel.size].astype(np.int64)
+                prices_full = np.concatenate(
+                    [pe_now, pm_exc, np.int64([pt_now])]
+                )
+                if status == "certified":
+                    return accept(prices_full)
+                add_cols, worst = viol, int(worst_c)
+
+        if add_cols is None:
+            # Classic full-plane pass (also the cache's refresh point:
+            # the lift's per-column minima are exactly the new floors).
+            prices_full, min_e_eff = lift_prices(
+                sel, sol_r.prices, costs=eff_full, capacity=capacity,
+                scale=scale, with_min_e=True,
+            )
+            eps_full = _certified_eps(
+                flows_full, sol_r.unsched, prices_full, costs=eff_full,
+                supply=supply, capacity=capacity,
+                unsched_cost=unsched_cost, scale=scale,
+                arc_capacity=arc_capacity,
+            )
+            if eps_full <= 1:
+                if cert is not None:
+                    min_e_base = min_e_eff
+                    if eff_full is not costs and forbidden.any():
+                        # Floors must cover the BASE plane: a row the
+                        # gang repair forbade re-opens next round.
+                        sub = costs[forbidden]
+                        val = np.where(
+                            sub < INF_COST,
+                            sub.astype(np.int64) * scale
+                            + pe_now[forbidden][:, None],
+                            _POS64,
+                        )
+                        min_e_base = np.minimum(
+                            min_e_eff, val.min(axis=0)
+                        )
+                    cert.refresh(
+                        scale=scale, pe=pe_now, min_e=min_e_base
+                    )
+                return accept(prices_full)
+            if rnd == max_rounds:
+                break
+            add_cols, worst = price_out_violations(
+                prices_full, costs=eff_full, supply=supply,
+                capacity=capacity, arc_capacity=arc_capacity,
+                scale=scale, mask=mask, top_j=top_j,
+            )
         if rnd == max_rounds:
             break
-        add_cols, worst = price_out_violations(
-            prices_full, costs=eff_full, supply=supply, capacity=capacity,
-            arc_capacity=arc_capacity, scale=scale, mask=mask, top_j=top_j,
-        )
         if add_cols.size == 0:
             break  # violation inside the union: growing columns can't help
         mask[add_cols] = True

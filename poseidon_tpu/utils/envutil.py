@@ -17,6 +17,16 @@ import time
 from typing import Optional
 
 
+def env_int(name: str, default: int) -> int:
+    """Integer knob from the environment; unparseable values fall back
+    to the default (shared by the POSEIDON_* tuning knobs — one parser,
+    one set of semantics)."""
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 def clean_cpu_env(root: str, n_devices: Optional[int] = None) -> dict:
     """Environment for a clean-CPU child process.
 

@@ -35,6 +35,12 @@ def test_features_config_smoke():
     aff = out["pod_affinity"]
     assert aff["colocated"] == aff["targets"] > 0
 
+    # Delta-plane telemetry rides the artifact (the hits themselves
+    # belong to the steady-state churn loop — see
+    # test_churn_rounds_serve_incrementally below).
+    assert "cost_delta_hits" in sel
+    assert "cost_delta_hits" in out["pod_affinity"]["round_metrics"]
+
     g = out["gang"]
     assert g["placed_gangs"] == g["gangs"] > 0
     assert g["partial_gangs"] == 0
@@ -46,3 +52,48 @@ def test_features_config_smoke():
     for key in ("bands", "shortlist_width", "price_out_rounds",
                 "escalations"):
         assert key in g["pruned"], f"pruned stats missing {key}"
+
+
+def test_churn_rounds_serve_incrementally(monkeypatch):
+    """The acceptance invariant for the incremental round engine:
+    steady-state churn rounds (same-shape resubmissions, the
+    ``churn_step`` loop the rung bench measures) NEVER rebuild the full
+    cost plane — every one is a delta hit with small rebuild counts."""
+    monkeypatch.setenv("POSEIDON_COST_DELTA_MIN_CELLS", "1")
+    monkeypatch.setenv("POSEIDON_COST_DELTA_MIN_ROWS", "1")
+    import numpy as np
+
+    import bench
+
+    # The rung's steady-state regime scaled down PRESERVING the churn-
+    # tasks-per-EC-shape ratio (10k rung: 1000 churn tasks over 100
+    # shapes -> every pending EC row recurs round over round; rows stay
+    # clean and only the churned columns rebuild).  A shape-rich tiny
+    # cluster instead turns over its whole pending EC set each round,
+    # where the full rebuild is the RIGHT answer.
+    state = bench.build_cluster(200, 2000, 4, seed=0)
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+    planner.schedule_round()  # cold round: full builds expected
+    rng = np.random.default_rng(7)
+    delta_rounds = 0
+    for r in range(5):
+        bench.churn_step(state, rng, frac=200)
+        _, m = planner.schedule_round()
+        if m.cost_delta_hits:
+            delta_rounds += 1
+            # A hit must be INCREMENTAL: only the churned columns
+            # rebuild, not the plane (200 machines here).
+            assert m.cost_cols_rebuilt <= 40 * m.cost_delta_hits, (
+                f"round {r}: delta hit rebuilt "
+                f"{m.cost_cols_rebuilt} columns"
+            )
+    # Round 1 pays the band's first snapshot, and a round whose tiny
+    # pending-EC set turned over legitimately full-rebuilds (one new
+    # row is 200 columns of work against a 3x200/4 budget) — but the
+    # steady rounds in between MUST serve incrementally.
+    assert delta_rounds >= 2, (
+        f"only {delta_rounds}/5 churn rounds served incrementally"
+    )

@@ -1,0 +1,625 @@
+"""Delta-maintained cost planes (costmodel/delta.py), the reduced-plane
+excluded-column certificate (ops/transport_pruned.ExcludedColumnCert),
+the accepted-shortlist revival, and the cross-band cost-build pipeline
+(graph/pipeline.py).
+
+The contract under test everywhere: the incremental paths are
+PERFORMANCE paths — bit-identical planes (the full ``model.build`` is
+kept verbatim as the oracle), certified-or-escalate accepts, and
+placements identical to the all-paths-off planner.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.costmodel import get_cost_model
+from poseidon_tpu.costmodel.delta import CostPlaneCache
+from poseidon_tpu.graph.instance import RoundPlanner
+from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+from poseidon_tpu.utils.ids import generate_uuid, task_uid
+
+DELTA_ENV = {
+    "POSEIDON_COST_DELTA_MIN_CELLS": "1",
+    "POSEIDON_COST_DELTA_MIN_ROWS": "1",
+}
+
+
+@pytest.fixture
+def delta_env(monkeypatch):
+    for k, v in DELTA_ENV.items():
+        monkeypatch.setenv(k, v)
+
+
+def _cluster(n_machines, rng, labeled=True):
+    state = ClusterState()
+    for i in range(n_machines):
+        state.node_added(MachineInfo(
+            uuid=generate_uuid(f"cd-m{i}"), cpu_capacity=32000,
+            ram_capacity=128 << 20, task_slots=16,
+            labels={"zone": f"z{i % 3}"} if labeled else {},
+        ))
+    return state
+
+
+def _submit(state, uid_counter, n, rng, shapes, gang=False, labels=None):
+    for _ in range(n):
+        i = uid_counter[0]
+        uid_counter[0] += 1
+        cpu, ram = shapes[int(rng.integers(len(shapes)))]
+        state.task_submitted(TaskInfo(
+            uid=task_uid("cd-t", i), job_id=f"cd-j{i % 9}",
+            cpu_request=cpu, ram_request=ram, gang=gang,
+            labels=dict(labels) if labels else {},
+        ))
+
+
+class TestChurnParity:
+    def test_randomized_churn_parity(self, delta_env):
+        """Long-churn rounds through a real ClusterState (placements
+        move residents, stats move utilization, nodes relabel/leave):
+        the delta-maintained plane is bit-identical to the full-rebuild
+        oracle every round, and actually serves incrementally on
+        steady-state rounds (this is not a vacuous gate)."""
+        rng = np.random.default_rng(42)
+        state = _cluster(40, rng)
+        shapes = [(200, 1 << 19), (400, 1 << 20), (800, 1 << 19)]
+        uidc = [0]
+        # More tasks than slots (40 x 16 = 640): a persistent backlog
+        # keeps the same EC rows pending round after round — the
+        # steady-state shape the delta path exists for.  (All-new churn
+        # ECs legitimately full-rebuild: every row is dirty.)
+        _submit(state, uidc, 900, rng, shapes,
+                labels={"app": "seed"})
+        model = get_cost_model("cpu_mem")
+        cache = CostPlaneCache(model)
+        planner = RoundPlanner(state, model)
+        delta_rounds = 0
+        for rnd in range(14):
+            view = state.build_round_view()
+            if view.ecs.num_ecs and view.machines.num_machines:
+                got = cache.build(0, view.ecs, view.machines)
+                want = model.build(view.ecs, view.machines)
+                assert (got.costs == want.costs).all(), f"round {rnd}"
+                assert (got.arc_capacity == want.arc_capacity).all()
+                assert (got.unsched_cost == want.unsched_cost).all()
+                assert (got.capacity == want.capacity).all()
+                if cache.last_stats["delta_hit"]:
+                    delta_rounds += 1
+                    E, M = view.ecs.num_ecs, view.machines.num_machines
+                    assert (cache.last_stats["rows_rebuilt"] < E
+                            or cache.last_stats["cols_rebuilt"] < M)
+            planner.schedule_round()  # placements move residents/usage
+            # Churn: small task turnover; occasional node events.
+            live = [t for t in state.tasks.values() if t.scheduled_to]
+            for t in live[: int(rng.integers(0, 6))]:
+                state.task_removed(t.uid)
+            _submit(state, uidc, int(rng.integers(1, 6)), rng, shapes,
+                    labels={"app": f"a{rnd % 4}"})
+            if rnd == 5:  # relabel one node in place
+                u = next(iter(state.machines))
+                m = state.machines[u]
+                state.node_updated(MachineInfo(
+                    uuid=u, cpu_capacity=m.cpu_capacity,
+                    ram_capacity=m.ram_capacity, task_slots=m.task_slots,
+                    labels={"zone": "relabeled"},
+                ))
+            if rnd == 8:  # usage update via the knowledge-base path
+                for u in list(state.machines)[:7]:
+                    state.add_node_stats(
+                        state.machines[u].resource_uuid
+                        if hasattr(state.machines[u], "resource_uuid")
+                        else u,
+                        {"cpu_utilization": 0.7, "mem_utilization": 0.5},
+                    )
+            if rnd == 10:  # machine leaves, another arrives
+                state.node_removed(next(iter(state.machines)))
+                state.node_added(MachineInfo(
+                    uuid=generate_uuid("cd-m-new"), cpu_capacity=16000,
+                    ram_capacity=64 << 20, task_slots=16,
+                    labels={"zone": "z9"},
+                ))
+        assert delta_rounds >= 3, (
+            f"delta path served only {delta_rounds} rounds — the "
+            "incremental engine silently fell back to full rebuilds"
+        )
+
+    def test_relabel_dirties_only_that_column(self, delta_env):
+        """Steady state, one machine relabeled: exactly that column is
+        rebuilt (plus any the placements dirtied), and the plane stays
+        oracle-identical."""
+        rng = np.random.default_rng(7)
+        state = _cluster(24, rng)
+        uidc = [0]
+        _submit(state, uidc, 40, rng, [(300, 1 << 19)])
+        model = get_cost_model("cpu_mem")
+        cache = CostPlaneCache(model)
+        view = state.build_round_view()
+        cache.build(0, view.ecs, view.machines)
+        # Relabel machine column 3 in place; nothing else moves.
+        u = view.machines.uuids[3]
+        m = state.machines[u]
+        state.node_updated(MachineInfo(
+            uuid=u, cpu_capacity=m.cpu_capacity,
+            ram_capacity=m.ram_capacity, task_slots=m.task_slots,
+            labels={"zone": "flipped"},
+        ))
+        view2 = state.build_round_view()
+        got = cache.build(0, view2.ecs, view2.machines)
+        want = model.build(view2.ecs, view2.machines)
+        assert (got.costs == want.costs).all()
+        assert (got.arc_capacity == want.arc_capacity).all()
+        stats = cache.last_stats
+        assert stats["path"] == "delta", stats
+        new_col = list(view2.machines.uuids).index(u)
+        assert new_col in stats["dirty_cols"].tolist()
+        assert stats["cols_rebuilt"] <= 2
+        assert stats["rows_rebuilt"] == 0
+
+    def test_interner_identity_change_falls_back_to_oracle(
+            self, delta_env, monkeypatch):
+        """Resident-interner compaction installs new id dicts, remapping
+        count-matrix columns — the cache must detect the identity change
+        and take the oracle rebuild, never diff across the remap."""
+        from poseidon_tpu.graph import residency
+
+        monkeypatch.setattr(residency, "_COMPACT_MIN_COLS", 8)
+        rng = np.random.default_rng(3)
+        state = _cluster(48, rng)
+        uidc = [0]
+        model = get_cost_model("cpu_mem")
+        cache = CostPlaneCache(model)
+        planner = RoundPlanner(state, model)
+        # A persistent UNPLACEABLE backlog (requests exceed every
+        # machine) keeps stable EC rows pending — the delta path's
+        # steady state — while leaving all slots free, so the unique-
+        # labeled churn residents below always place, then leave,
+        # minting and killing kv columns until compaction fires and
+        # installs new interner id dicts.  The backlog carries pod
+        # anti-affinity so the resident interner is ACTIVE (it only
+        # runs while pod-selector tasks exist).
+        from poseidon_tpu.costmodel.selectors import IN_SET
+
+        for _ in range(40):
+            i = uidc[0]
+            uidc[0] += 1
+            state.task_submitted(TaskInfo(
+                uid=task_uid("cd-t", i), job_id=f"cd-j{i % 9}",
+                # Distinct shapes: 40 stable EC ROWS (a single merged
+                # EC would leave every round mostly-dirty by fraction).
+                cpu_request=64000 + 50 * i, ram_request=1 << 19,
+                labels={"app": "base"},
+                pod_anti_affinity=((IN_SET, "nope", ("x",)),),
+            ))
+        saw_identity_change = saw_delta = False
+        prev_kv_id = None
+        for rnd in range(12):
+            view = state.build_round_view()
+            if view.ecs.num_ecs:
+                got = cache.build(0, view.ecs, view.machines)
+                want = model.build(view.ecs, view.machines)
+                assert (got.costs == want.costs).all(), f"round {rnd}"
+                assert (got.arc_capacity == want.arc_capacity).all()
+                res = view.machines.residents
+                if res is not None:
+                    if (prev_kv_id is not None
+                            and res.kv_id is not prev_kv_id):
+                        saw_identity_change = True
+                        # Columns remapped: the cell-level diff is
+                        # meaningless; the oracle must own this round.
+                        assert cache.last_stats["path"] != "delta", (
+                            f"round {rnd}: diffed across an interner "
+                            "compaction"
+                        )
+                    prev_kv_id = res.kv_id
+                if cache.last_stats["path"] == "delta":
+                    saw_delta = True
+            planner.schedule_round()
+            # Remove LAST round's churn residents (their unique labels'
+            # kv columns die), then mint fresh ones this round.
+            placed_churn = 0
+            for t in list(state.tasks.values()):
+                if t.labels.get("gen") and t.scheduled_to:
+                    placed_churn += 1
+                    state.task_removed(t.uid)
+            if rnd:
+                assert placed_churn > 0, "churn residents never placed"
+            first = uidc[0]
+            _submit(state, uidc, 3, rng, [(250, 1 << 19)],
+                    labels={"gen": f"g{rnd}", "u": f"v{first}"})
+        assert saw_identity_change, (
+            "compaction never fired — the identity guard went untested"
+        )
+        assert saw_delta, "delta path never served"
+
+    def test_dirty_fraction_gate_escalates_to_full(self, delta_env):
+        """A round that moves most machine columns crosses the dirty-
+        fraction gate: one full rebuild, never a slower patchwork."""
+        rng = np.random.default_rng(11)
+        state = _cluster(20, rng, labeled=False)
+        uidc = [0]
+        _submit(state, uidc, 30, rng, [(300, 1 << 19)])
+        model = get_cost_model("cpu_mem")
+        cache = CostPlaneCache(model)
+        view = state.build_round_view()
+        cache.build(0, view.ecs, view.machines)
+        # Usage update on EVERY machine: all columns dirty.
+        for u in list(state.machines):
+            state.add_node_stats(u, {"cpu_utilization": 0.9})
+        view2 = state.build_round_view()
+        got = cache.build(0, view2.ecs, view2.machines)
+        want = model.build(view2.ecs, view2.machines)
+        assert (got.costs == want.costs).all()
+        assert cache.last_stats["path"] == "gate", cache.last_stats
+
+
+class TestPlaneLedger:
+    def _tables(self, rng, E, M, seed_used=0):
+        state = _cluster(M, rng, labeled=False)
+        uidc = [seed_used]
+        _submit(state, uidc, E, rng, [(300 + seed_used, 1 << 19)])
+        return state
+
+    def test_ledger_accumulates_across_builds(self, delta_env):
+        """Two delta builds between takes (the pipeline's speculative +
+        authoritative pair): take_ledger returns the UNION of their
+        dirty sets — a column only the first build patched must not
+        vanish from the certificate's fold feed."""
+        rng = np.random.default_rng(5)
+        state = _cluster(20, rng, labeled=False)
+        uidc = [0]
+        _submit(state, uidc, 30, rng, [(300, 1 << 19)])
+        model = get_cost_model("cpu_mem")
+        cache = CostPlaneCache(model)
+        view = state.build_round_view()
+        cache.build(0, view.ecs, view.machines)
+        cache.take_ledger(0)  # anchor point
+
+        state.add_node_stats(list(state.machines)[2],
+                             {"cpu_utilization": 0.8})
+        v1 = state.build_round_view()
+        cache.build(0, v1.ecs, v1.machines)  # build 1 dirties col 2
+        assert cache.last_stats["path"] == "delta"
+        d1 = set(v1.machines.uuids[int(j)]
+                 for j in cache.last_stats["dirty_cols"])
+
+        state.add_node_stats(list(state.machines)[7],
+                             {"cpu_utilization": 0.6})
+        v2 = state.build_round_view()
+        cache.build(0, v2.ecs, v2.machines)  # build 2 dirties col 7
+        assert cache.last_stats["path"] == "delta"
+        d2 = set(v2.machines.uuids[int(j)]
+                 for j in cache.last_stats["dirty_cols"])
+
+        led = cache.take_ledger(0)
+        assert led is not None and not led.broken
+        assert d1 | d2 <= led.cols, (
+            "ledger lost a build's dirty columns — the certificate "
+            "would fold against a stale floor"
+        )
+        assert cache.take_ledger(0) is None  # consumed
+
+    def test_full_rebuild_breaks_ledger(self, delta_env):
+        rng = np.random.default_rng(6)
+        state = _cluster(16, rng, labeled=False)
+        uidc = [0]
+        _submit(state, uidc, 20, rng, [(300, 1 << 19)])
+        model = get_cost_model("cpu_mem")
+        cache = CostPlaneCache(model)
+        view = state.build_round_view()
+        cache.build(0, view.ecs, view.machines)
+        cache.take_ledger(0)
+        # All-new EC population: dirty gate -> full rebuild.
+        for uid in list(state.tasks):
+            state.task_removed(uid)
+        _submit(state, uidc, 20, rng, [(999, 1 << 20)])
+        v2 = state.build_round_view()
+        cache.build(0, v2.ecs, v2.machines)
+        led = cache.take_ledger(0)
+        assert led is not None and led.broken
+
+
+class TestExcludedColumnCert:
+    """Unit tests against a hand-built plane: the cert must reproduce
+    the classic full-plane accept boundary, and a cost drop on a dirty
+    excluded column must surface as a violation, never a blind accept."""
+
+    def _setup(self, E=12, M=40, scale=64, seed=0):
+        from poseidon_tpu.costmodel.delta import PlaneLedger
+        from poseidon_tpu.ops import transport_pruned as tp
+
+        rng = np.random.default_rng(seed)
+        costs = rng.integers(10, 400, size=(E, M)).astype(np.int32)
+        pe = rng.integers(-2000, 2000, size=E).astype(np.int64)
+        supply = np.full(E, 2, dtype=np.int32)
+        capacity = np.full(M, 4, dtype=np.int32)
+        cert = tp.ExcludedColumnCert()
+        ec_ids = np.arange(E, dtype=np.uint64)
+        uuids = [f"u{j}" for j in range(M)]
+        led = PlaneLedger()
+        led.present = set(range(E))
+        cert.note_build(ec_ids, uuids, led)
+        min_e = (costs.astype(np.int64) * scale + pe[:, None]).min(axis=0)
+        cert.refresh(scale=scale, pe=pe, min_e=min_e)
+        return tp, cert, costs, pe, supply, capacity, ec_ids, uuids, scale
+
+    @staticmethod
+    def _oracle_viol(costs, pe, pt, supply, capacity, scale, mask):
+        """The lift's exact accept boundary for excluded columns."""
+        excluded = np.nonzero(~mask)[0]
+        viol = []
+        for m in excluded:
+            if capacity[m] <= 0:
+                continue
+            vals = [
+                int(costs[e, m]) * scale + int(pe[e])
+                for e in range(costs.shape[0])
+                if costs[e, m] < np.iinfo(np.int32).max // 2
+                and supply[e] > 0
+            ]
+            if vals and min(vals) < pt - 2:
+                viol.append(int(m))
+        return viol
+
+    def test_unchanged_plane_certifies(self):
+        (tp, cert, costs, pe, supply, capacity,
+         ec_ids, uuids, scale) = self._setup()
+        mask = np.zeros(costs.shape[1], dtype=bool)
+        mask[:8] = True
+        # pt low enough that every excluded column prices out clean.
+        pt = int((costs.astype(np.int64) * scale
+                  + pe[:, None]).min()) - 10
+        status, viol, worst, pm = cert.check(
+            eff_costs=costs, pe=pe, pt=pt, supply=supply,
+            capacity=capacity, arc_capacity=None, scale=scale, mask=mask,
+        )
+        assert status == "certified", (status, viol)
+        assert self._oracle_viol(
+            costs, pe, pt, supply, capacity, scale, mask) == []
+
+    def test_dirty_column_cost_drop_is_caught(self):
+        """A dirty excluded column whose cost collapsed must come back
+        as a violation (soundness: the fold sees the CURRENT cells)."""
+        from poseidon_tpu.costmodel.delta import PlaneLedger
+
+        (tp, cert, costs, pe, supply, capacity,
+         ec_ids, uuids, scale) = self._setup()
+        mask = np.zeros(costs.shape[1], dtype=bool)
+        mask[:8] = True
+        base = costs.astype(np.int64) * scale + pe[:, None]
+        pt = int(base[:, mask].min())  # boundary near the included plane
+        # Collapse excluded column 20 far below the accept boundary and
+        # report it dirty.
+        costs2 = costs.copy()
+        costs2[:, 20] = 0
+        led = PlaneLedger()
+        led.present = set(int(e) for e in ec_ids.tolist())
+        led.cols = {uuids[20]}
+        cert.note_build(ec_ids, uuids, led)
+        assert cert.begin_attempt(costs2, scale)
+        status, viol, worst, pm = cert.check(
+            eff_costs=costs2, pe=pe, pt=pt, supply=supply,
+            capacity=capacity, arc_capacity=None, scale=scale, mask=mask,
+        )
+        oracle = self._oracle_viol(
+            costs2, pe, pt, supply, capacity, scale, mask)
+        if 20 in oracle:
+            assert status == "violations" and 20 in viol.tolist(), (
+                status, viol, oracle)
+
+    def test_unreported_build_never_certifies(self):
+        """A build the ledger never saw (None) breaks the chain: the
+        cert must refuse to certify what it cannot prove."""
+        (tp, cert, costs, pe, supply, capacity,
+         ec_ids, uuids, scale) = self._setup()
+        cert.note_build(ec_ids, uuids, None)
+        assert not cert.begin_attempt(costs, scale)
+        status, *_ = cert.check(
+            eff_costs=costs, pe=pe, pt=0, supply=supply,
+            capacity=capacity, arc_capacity=None, scale=scale,
+            mask=np.zeros(costs.shape[1], dtype=bool),
+        )
+        assert status == "inconclusive"
+
+    def test_heavy_drift_rows_demoted_not_inconclusive(self):
+        """A few rows with collapsed prices (the gang-repair shape) go
+        to the exact path; the bound stays tight for the rest and the
+        check still reaches a verdict instead of giving up."""
+        (tp, cert, costs, pe, supply, capacity,
+         ec_ids, uuids, scale) = self._setup(E=32, M=64, seed=2)
+        mask = np.zeros(costs.shape[1], dtype=bool)
+        mask[:16] = True
+        pt = int((costs.astype(np.int64) * scale
+                  + pe[:, None]).min()) - 10
+        pe2 = pe.copy()
+        pe2[:3] -= 500_000  # three heavy drifters...
+        from poseidon_tpu.ops.transport import INF_COST
+
+        eff = costs.copy()
+        eff[:3] = INF_COST  # ...whose rows the repair FORBADE (the
+        # real gang shape: collapsed pe, inadmissible arcs)
+        status, viol, worst, pm = cert.check(
+            eff_costs=eff, pe=pe2, pt=pt, supply=supply,
+            capacity=capacity, arc_capacity=None, scale=scale, mask=mask,
+        )
+        assert status == "certified", (status, viol)
+        assert self._oracle_viol(
+            eff, pe2, pt, supply, capacity, scale, mask) == []
+
+
+class TestShortlistRevival:
+    def test_second_round_revives_accepted_union(self, monkeypatch):
+        """Two warm rounds of the same pruned band: round 2 must revive
+        round 1's accepted union instead of re-running the planner."""
+        monkeypatch.setenv("POSEIDON_PRUNE_MIN_ROWS", "8")
+        monkeypatch.setenv("POSEIDON_PRUNE_MIN_COLS", "32")
+        for k, v in DELTA_ENV.items():
+            monkeypatch.setenv(k, v)
+        from poseidon_tpu.ops import transport_pruned as tp
+
+        calls = []
+        real_plan = tp.plan_shortlist
+
+        def counting_plan(*a, **kw):
+            calls.append(1)
+            return real_plan(*a, **kw)
+
+        monkeypatch.setattr(tp, "plan_shortlist", counting_plan)
+        rng = np.random.default_rng(9)
+        state = _cluster(80, rng, labeled=False)
+        uidc = [0]
+        # Many distinct shapes -> enough EC rows for the pruned gate.
+        shapes = [(100 + 13 * i, 1 << 19) for i in range(24)]
+        _submit(state, uidc, 200, rng, shapes)
+        model = get_cost_model("cpu_mem")
+        planner = RoundPlanner(state, model)
+        planner.schedule_round()
+        if planner.last_metrics.pruned_bands == 0:
+            pytest.skip("pruned gate declined at this scale")
+        n_round1 = len(calls)
+        assert n_round1 >= 1
+        # Steady-state churn: remove a few, resubmit same shapes.
+        live = [t for t in state.tasks.values() if t.scheduled_to]
+        for t in live[:5]:
+            state.task_removed(t.uid)
+        _submit(state, uidc, 5, rng, shapes)
+        planner.schedule_round()
+        m2 = planner.last_metrics
+        if m2.pruned_bands and m2.cost_delta_hits:
+            assert len(calls) == n_round1, (
+                "round 2 re-ran plan_shortlist despite a revivable "
+                "accepted union"
+            )
+
+    def test_revive_declines_on_machine_churn(self, monkeypatch):
+        """>3% of the saved union's machines gone -> replan."""
+        planner = RoundPlanner.__new__(RoundPlanner)
+        planner._shortlist_bands = {
+            5: ([f"u{j}" for j in range(100)], 7)}
+
+        class _E:
+            supply = np.full(200, 2, dtype=np.int32)
+        monkeypatch.setenv("POSEIDON_PRUNE_MIN_ROWS", "1")
+        monkeypatch.setenv("POSEIDON_PRUNE_MIN_COLS", "1")
+        col_cap = np.full(500, 8, dtype=np.int32)
+        # All saved machines present: revives.
+        uuids = [f"u{j}" for j in range(500)]
+        plan = planner._revive_shortlist(5, _E, col_cap, None, uuids,
+                                         fresh_ok=True)
+        assert plan is not None
+        saved_cols = set(range(100))
+        assert saved_cols <= set(plan.sel.tolist())
+        # 10 of the 100 saved machines gone: declines.
+        uuids2 = [f"u{j}" for j in range(10, 510)]
+        assert planner._revive_shortlist(
+            5, _E, col_cap, None, uuids2, fresh_ok=True) is None
+        # Not fresh: declines outright.
+        assert planner._revive_shortlist(
+            5, _E, col_cap, None, uuids, fresh_ok=False) is None
+
+
+class TestCostPipeline:
+    class _SlowModel:
+        """Cost-model stand-in whose build sleeps, so the overlap
+        window is deterministic."""
+        delta_plane = False
+
+        def __init__(self, dt=0.05):
+            self.dt = dt
+            self.builds = []
+            self.fail_next = False
+
+        def build(self, ecs, machines):
+            if self.fail_next:
+                self.fail_next = False
+                raise RuntimeError("speculative boom")
+            time.sleep(self.dt)
+            self.builds.append(threading.current_thread().name)
+            from poseidon_tpu.costmodel.base import CostMatrices
+            E, M = ecs.num_ecs, machines.num_machines
+            return CostMatrices(
+                costs=np.zeros((E, M), dtype=np.int32),
+                unsched_cost=np.zeros(E, dtype=np.int32),
+                capacity=machines.slots_free.astype(np.int32),
+                arc_capacity=None,
+            )
+
+    def _tables(self):
+        rng = np.random.default_rng(1)
+        state = _cluster(12, rng, labeled=False)
+        uidc = [0]
+        _submit(state, uidc, 10, rng, [(300, 1 << 19)])
+        v = state.build_round_view()
+        return v.ecs, v.machines
+
+    def test_overlap_window_math(self):
+        from poseidon_tpu.graph.pipeline import CostPipeline
+
+        model = self._SlowModel(dt=0.08)
+        pipe = CostPipeline(CostPlaneCache(model))
+        ecs, mt = self._tables()
+        pipe.speculate(1, ecs, mt)
+        t0 = time.perf_counter()
+        time.sleep(0.02)  # "solving" while the worker builds
+        cm, stats = pipe.build(1, ecs, mt)
+        overlap = pipe.overlap_with(t0, time.perf_counter())
+        assert overlap > 0.0
+        assert cm.costs.shape == (ecs.num_ecs, mt.num_machines)
+
+    def test_speculative_error_is_swallowed_authoritative_raises(self):
+        from poseidon_tpu.graph.pipeline import CostPipeline
+
+        model = self._SlowModel(dt=0.0)
+        cache = CostPlaneCache(model)
+        pipe = CostPipeline(cache)
+        ecs, mt = self._tables()
+        model.fail_next = True
+        pipe.speculate(1, ecs, mt)   # worker raises; round must survive
+        cm, stats = pipe.build(1, ecs, mt)  # authoritative recomputes
+        assert cm is not None
+        model.fail_next = True
+        with pytest.raises(RuntimeError):
+            pipe.build(1, ecs, mt)   # the REAL build's errors propagate
+        pipe.drain()
+
+    def test_planner_parity_pipeline_on_off(self, monkeypatch):
+        """Multi-band rounds with the pipeline on vs off place
+        identically (speculation is never wrong-RESULT)."""
+        for k, v in DELTA_ENV.items():
+            monkeypatch.setenv(k, v)
+
+        def run(pipeline_on):
+            monkeypatch.setenv("POSEIDON_PIPELINE_BANDS",
+                               "1" if pipeline_on else "0")
+            rng = np.random.default_rng(4)
+            state = _cluster(30, rng, labeled=False)
+            uidc = [0]
+            # Two supply bands: singles and 8-task jobs.
+            _submit(state, uidc, 40, rng, [(200, 1 << 19)])
+            for g in range(10):
+                for i in range(8):
+                    state.task_submitted(TaskInfo(
+                        uid=task_uid(f"cd-band2-{g}", i),
+                        job_id=f"cd-b2-{g}",
+                        cpu_request=900 + g, ram_request=1 << 20,
+                    ))
+            model = get_cost_model("cpu_mem")
+            planner = RoundPlanner(state, model)
+            digests = []
+            for r in range(4):
+                planner.schedule_round()
+                digests.append(sorted(
+                    (t.uid, t.scheduled_to)
+                    for t in state.tasks.values() if t.scheduled_to
+                ))
+                live = [t for t in state.tasks.values()
+                        if t.scheduled_to]
+                for t in live[:4]:
+                    state.task_removed(t.uid)
+                _submit(state, uidc, 4, rng, [(200, 1 << 19)])
+            return digests
+
+        assert run(False) == run(True)

@@ -266,6 +266,13 @@ def test_gang_repair_multi_firing_pruned(monkeypatch):
     monkeypatch.setenv("POSEIDON_PRUNE_MIN_COLS", "64")
     m = _run_multi_firing(_multi_firing_cluster())
     assert m.pruned_bands >= 1, "shortlist gate never fired"
+    # The repair re-solves must accept on the REDUCED plane: the
+    # incremental excluded-column certificate, fed the first accept's
+    # full pass as its anchor, answers the later attempts without the
+    # full-plane O(E*M) lift (PR 7's reduced-plane certificates).
+    assert m.pruned_cert_accepts >= 1, (
+        "every pruned accept fell back to the full-plane pass"
+    )
 
 
 def test_gang_warm_round_is_compile_free():

@@ -429,6 +429,12 @@ class TestBandMerging:
         committed THIS round (a stale pre-round snapshot would merge
         bands the committed capacity can no longer hold)."""
         monkeypatch.setenv("POSEIDON_MERGE_BANDS", "1")
+        # The cross-band pipeline probes _next_band_group a second time
+        # per iteration with FROZEN pre-commit usage (a speculative
+        # grouping guess, by design) — pin it off so the spy sequence
+        # below observes only the authoritative gate calls this test is
+        # about.
+        monkeypatch.setenv("POSEIDON_PIPELINE_BANDS", "0")
         import numpy as np
 
         st = self._mixed_state(4, 64, 14, 40, cpu_cap=16000)

@@ -206,6 +206,59 @@ def test_chrome_trace_validator_catches_partial_overlap():
     )  # missing tid flags
 
 
+def test_chrome_trace_validator_cross_lane_overlap_is_legal():
+    """The pipelined round's shape: band k's solve on the planner lane
+    overlapping band k+1's cost build on the worker lane must validate
+    — different lanes, and the worker span's explicit parent (the
+    round) contains it in time."""
+    good = {"traceEvents": [
+        {"name": "round", "ph": "X", "ts": 0, "dur": 1000, "pid": 1,
+         "tid": 1, "args": {"span_id": 1}},
+        {"name": "round.solve_band", "ph": "X", "ts": 100, "dur": 500,
+         "pid": 1, "tid": 1, "args": {"span_id": 2, "parent_id": 1}},
+        {"name": "round.cost_build_spec", "ph": "X", "ts": 150,
+         "dur": 500, "pid": 1, "tid": 2,
+         "args": {"span_id": 3, "parent_id": 1}},
+    ]}
+    assert obs_trace.validate_chrome_trace(good) == []
+
+
+def test_chrome_trace_validator_same_lane_overlap_still_fails():
+    bad = {"traceEvents": [
+        {"name": "round", "ph": "X", "ts": 0, "dur": 1000, "pid": 1,
+         "tid": 1, "args": {"span_id": 1}},
+        {"name": "round.solve_band", "ph": "X", "ts": 100, "dur": 500,
+         "pid": 1, "tid": 1, "args": {"span_id": 2, "parent_id": 1}},
+        # Same lane as the solve, partially overlapping: bookkeeping
+        # bug, not concurrency.
+        {"name": "round.cost_build", "ph": "X", "ts": 400, "dur": 500,
+         "pid": 1, "tid": 1, "args": {"span_id": 3, "parent_id": 1}},
+    ]}
+    problems = obs_trace.validate_chrome_trace(bad)
+    assert any("partially overlaps" in p for p in problems)
+
+
+def test_chrome_trace_validator_child_escaping_parent_fails():
+    """A cross-thread child outside its explicit parent's interval is a
+    parenting bug even though the lanes differ."""
+    bad = {"traceEvents": [
+        {"name": "round", "ph": "X", "ts": 0, "dur": 100, "pid": 1,
+         "tid": 1, "args": {"span_id": 1}},
+        {"name": "round.cost_build_spec", "ph": "X", "ts": 90,
+         "dur": 500, "pid": 1, "tid": 2,
+         "args": {"span_id": 2, "parent_id": 1}},
+    ]}
+    problems = obs_trace.validate_chrome_trace(bad)
+    assert any("escapes its parent" in p for p in problems)
+    # Unknown parent ids are flagged too.
+    bad2 = {"traceEvents": [
+        {"name": "x", "ph": "X", "ts": 0, "dur": 10, "pid": 1, "tid": 1,
+         "args": {"span_id": 7, "parent_id": 99}},
+    ]}
+    assert any("unknown parent" in p
+               for p in obs_trace.validate_chrome_trace(bad2))
+
+
 def test_chrome_trace_attrs_are_json_safe(monkeypatch):
     monkeypatch.setenv(obs_trace.TRACE_ENV, "1")
     with obs_trace.span("round", obj=object(), ok=True, n=3):

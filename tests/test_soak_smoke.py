@@ -61,6 +61,33 @@ def test_soak_smoke_full_plan(tmp_path):
     assert rerun["digests"] == out["digests"]
 
 
+def test_soak_smoke_incremental_forced(tmp_path, monkeypatch):
+    """The incremental round engine under faults: the delta-maintained
+    cost planes forced to serve at soak scale (gate floors dropped), the
+    same kube-truth byte-identity and budget-0 warm-compile gates must
+    hold, and the delta path must have actually fired — a soak that
+    silently fell back to full rebuilds proves nothing."""
+    monkeypatch.setenv("POSEIDON_COST_DELTA_MIN_CELLS", "1")
+    monkeypatch.setenv("POSEIDON_COST_DELTA_MIN_ROWS", "1")
+    out = run_soak(
+        machines=MACHINES, rounds=ROUNDS, plan="smoke", seed=SEED,
+        out_dir=str(tmp_path),
+    )
+    assert out["ok"], out.get("failure")
+    assert out["divergent_rounds"] == 0
+    assert out["warm_fresh_compiles"] == 0
+    assert out["cost_delta_hits"] > 0, (
+        "incremental cost path never served during the forced soak"
+    )
+
+    rerun = run_soak(
+        machines=MACHINES, rounds=ROUNDS, plan="smoke", seed=SEED,
+        out_dir=str(tmp_path),
+    )
+    assert rerun["ok"], rerun.get("failure")
+    assert rerun["digests"] == out["digests"]
+
+
 def test_flight_recorder_kill_and_redrive(tmp_path):
     """Kill the Firmament stub mid-soak: the crash-loop budget stops the
     loop fatally, the flight recorder writes a trace, and the replay

@@ -13,7 +13,13 @@ artifact to ``out/trace_smoke.json``, and fails unless:
   ``round.view_build``) are its children, contained in its interval;
 - the span totals agree with ``stagetimer.snapshot()`` within 5%
   (tracer and stagetimer are two views of the same records — drift
-  means the shim broke).
+  means the shim broke);
+- a second, TWO-BAND traced round exercises the cross-band cost-build
+  pipeline (graph/pipeline.py): a ``round.cost_build_spec`` span must
+  land on a worker lane, cross-thread-parented to the round span, its
+  interval overlapping the first band's ``round.solve_band`` — and the
+  exported artifact (which now contains cross-LANE overlap) must still
+  validate, proving the validator's lane-aware nesting rules.
 
 CPU-pinned: a smoke gate must never contend for (or wedge on) the
 accelerator tunnel.
@@ -75,7 +81,11 @@ def validate_round_decomposition(spans, problems):
                 )
     stage_sum = sum(
         s["dur"] for s in by_parent.get(rnd["id"], [])
+        # Same-lane children only: a pipelined round's speculative cost
+        # build runs CONCURRENTLY on a worker lane, so it legitimately
+        # adds wall time beyond the round's own serial budget.
         if s["name"].startswith("round.")
+        and s.get("tid") == rnd.get("tid")
     )
     if stage_sum > rnd["dur"] * 1.001:
         problems.append(
@@ -101,6 +111,69 @@ def validate_stagetimer_parity(spans, snapshot, problems):
                 f"{stage}: span total {span_s:.4f}s vs stagetimer "
                 f"{timer_s:.4f}s (> {PARITY_TOLERANCE:.0%} apart)"
             )
+
+
+def validate_pipeline_overlap(spans, metrics, problems):
+    """The pipelined round's contract: the speculative cost build ran on
+    its own lane, parented to the round span across threads, and its
+    interval actually overlapped a band solve."""
+    rounds = [s for s in spans if s["name"] == "round"]
+    specs = [s for s in spans if s["name"] == "round.cost_build_spec"]
+    solves = [s for s in spans if s["name"] == "round.solve_band"]
+    if not rounds or not specs or not solves:
+        problems.append(
+            "pipelined round: missing round/cost_build_spec/solve_band "
+            f"spans ({len(rounds)}/{len(specs)}/{len(solves)})"
+        )
+        return
+    rnd = rounds[-1]
+    spec = specs[-1]
+    if spec.get("tid") == rnd.get("tid"):
+        problems.append(
+            "cost_build_spec ran on the planner lane, not a worker lane"
+        )
+    if spec.get("parent") != rnd["id"]:
+        problems.append(
+            "cost_build_spec is not cross-thread-parented to the round"
+        )
+    s0, s1 = spec["ts"], spec["ts"] + spec["dur"]
+    if not any(
+        min(s1, sv["ts"] + sv["dur"]) > max(s0, sv["ts"])
+        for sv in solves
+    ):
+        problems.append(
+            "cost_build_spec interval overlaps no band solve — the "
+            "pipeline submitted but never actually overlapped"
+        )
+    if not metrics.pipeline_overlap_s > 0:
+        problems.append(
+            f"pipeline_overlap_s={metrics.pipeline_overlap_s} — no "
+            "realized overlap recorded in RoundMetrics"
+        )
+
+
+def _two_band_cluster():
+    """~1200 machines, two size bands of 96 ECs each — big enough that
+    band 2's speculative build is still running when band 1's solve
+    starts (the overlap the pipelined round must realize)."""
+    from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+    from poseidon_tpu.utils.ids import generate_uuid, task_uid
+
+    state = ClusterState()
+    for i in range(1200):
+        state.node_added(MachineInfo(
+            uuid=generate_uuid(f"ts2-m{i}"), cpu_capacity=32000,
+            ram_capacity=128 << 20, task_slots=64,
+        ))
+    for necs, per_ec, cpu0 in ((96, 2, 100), (96, 32, 2000)):
+        for e in range(necs):
+            for i in range(per_ec):
+                state.task_submitted(TaskInfo(
+                    uid=task_uid(f"ts2-{cpu0}-{e}", i),
+                    job_id=f"ts2-{cpu0}-{e}",
+                    cpu_request=cpu0 + e, ram_request=1 << 19,
+                ))
+    return state
 
 
 def main() -> int:
@@ -137,16 +210,32 @@ def main() -> int:
                for e in obj["traceEvents"]):
         problems.append("exported artifact has no 'round' event")
 
-    n_events = sum(1 for e in obj["traceEvents"] if e.get("ph") == "X")
+    # Window 2: the PIPELINED round (two band groups -> the speculative
+    # cost build overlaps band 1's solve on a worker lane).  Exported
+    # over the same artifact path so the committed smoke covers the
+    # cross-lane-overlap shape the validator must accept.
+    obs_trace.reset()
+    state2 = _two_band_cluster()
+    planner2 = RoundPlanner(state2, get_cost_model("cpu_mem"))
+    _, metrics2 = planner2.schedule_round()
+    spans2 = obs_trace.spans()
+    obj2 = obs_trace.export_chrome_trace(OUT_PATH)
+    problems += obs_trace.validate_chrome_trace(obj2)
+    validate_round_decomposition(spans2, problems)
+    validate_pipeline_overlap(spans2, metrics2, problems)
+
+    n_events = sum(1 for e in obj2["traceEvents"] if e.get("ph") == "X")
     print(f"trace-smoke: round solve_tier={metrics.solve_tier} "
-          f"placed={metrics.placed}; {len(spans)} spans, "
+          f"placed={metrics.placed}; {len(spans)} spans; pipelined "
+          f"round overlap={metrics2.pipeline_overlap_s}s "
+          f"delta_hits={metrics2.cost_delta_hits}; "
           f"{n_events} events -> {OUT_PATH}")
     if problems:
         for prob in problems:
             print(f"trace-smoke: FAIL {prob}", file=sys.stderr)
         return 1
-    print("trace-smoke: artifact valid (nesting, Perfetto format, "
-          "stagetimer parity)")
+    print("trace-smoke: artifact valid (nesting incl. cross-lane "
+          "pipeline overlap, Perfetto format, stagetimer parity)")
     return 0
 
 
