@@ -54,19 +54,23 @@ trace-smoke:
 # itself, which would make the gate vacuous): machines that never ran
 # the bench stay green, while a PR that commits a regressed baseline
 # fails against its predecessors.
-# First parseable baseline wins.  bench_r07_baseline.json carries the
-# incremental-round-engine stage series (PR 7); r06 is the first
-# artifact with the per-stage features series (mask/cost/solve/view) —
-# without one of them those rows fall in "skipped" and only headline
-# round timings are gated.
+# First parseable baseline wins.  bench_r08_baseline.json adds the
+# per-round device-work series (wave/churn solve_iters, bf_sweeps,
+# device_calls — gated as counts, machine-independently);
+# bench_r07_baseline.json carries the incremental-round-engine stage
+# series (PR 7); r06 is the first artifact with the per-stage features
+# series (mask/cost/solve/view) — without one of them those rows fall
+# in "skipped" and only headline round timings are gated.
 PERF_FRESH := $(wildcard out/bench_gate.jsonl)
 ifeq ($(PERF_FRESH),)
-PERF_BENCH ?= docs/bench_r07_baseline.json
-PERF_BASELINES = --baseline docs/bench_r06_baseline.json \
+PERF_BENCH ?= docs/bench_r08_baseline.json
+PERF_BASELINES = --baseline docs/bench_r07_baseline.json \
+  --baseline docs/bench_r06_baseline.json \
   --baseline docs/bench_r05_final.json
 else
 PERF_BENCH ?= $(PERF_FRESH)
-PERF_BASELINES = --baseline docs/bench_r07_baseline.json \
+PERF_BASELINES = --baseline docs/bench_r08_baseline.json \
+  --baseline docs/bench_r07_baseline.json \
   --baseline docs/bench_r06_baseline.json \
   --baseline docs/bench_r05_final.json
 endif

@@ -374,7 +374,16 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
 
     # Full waves, each a FRESH population: drain everything, submit new
     # random shapes (new seed => new ECs/costs; nothing bit-identical).
+    # Per-round DEVICE series ride the artifact alongside the wall-time
+    # percentiles (solve iterations, BF sweeps, dispatches, ladder entry
+    # phase) so tools/bench_compare.py gates device work directly — a
+    # regression that trades iterations for overlapped wall time (or
+    # vice versa) is visible either way.
     wave_lat = []
+    wave_solve_iters = []
+    wave_bf_sweeps = []
+    wave_device_calls = []
+    wave_entry_phase = []
     placed = unsched = 0
     objective = 0
     for r in range(rounds):
@@ -385,6 +394,10 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
         _, metrics = planner.schedule_round()
         dt = time.perf_counter() - t0
         wave_lat.append(dt)
+        wave_solve_iters.append(metrics.iterations)
+        wave_bf_sweeps.append(metrics.bf_sweeps)
+        wave_device_calls.append(metrics.device_calls)
+        wave_entry_phase.append(metrics.ladder_entry_phase)
         placed, unsched = metrics.placed, metrics.unscheduled
         objective = metrics.objective
         converged = converged and metrics.converged
@@ -393,7 +406,13 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
                   f"solve={metrics.solve_seconds:.3f}s placed={placed} "
                   f"unsched={unsched} gap={metrics.gap_bound} "
                   f"iters={metrics.iterations} bf={metrics.bf_sweeps} "
-                  f"calls={metrics.device_calls}",
+                  f"calls={metrics.device_calls} "
+                  f"entry={metrics.ladder_entry_phase} "
+                  f"phases={metrics.solve_phase_iters} "
+                  f"pruned={metrics.pruned_bands}/"
+                  f"w{metrics.pruned_width}/"
+                  f"esc{metrics.pruned_escalations} "
+                  f"fresh={metrics.fresh_compiles}",
                   file=sys.stderr)
         partial.update(
             precompile_s=round(precompile_s, 4),
@@ -410,6 +429,8 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
     rng = np.random.default_rng(12345)
     churn_lat = []
     churn_delta_hits = []
+    churn_solve_iters = []
+    churn_device_calls = []
     churn_rows_rebuilt = churn_cols_rebuilt = 0
     for r in range(rounds):
         churn_step(state, rng)
@@ -418,6 +439,8 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
         dt = time.perf_counter() - t0
         churn_lat.append(dt)
         churn_delta_hits.append(metrics.cost_delta_hits)
+        churn_solve_iters.append(metrics.iterations)
+        churn_device_calls.append(metrics.device_calls)
         churn_rows_rebuilt += metrics.cost_rows_rebuilt
         churn_cols_rebuilt += metrics.cost_cols_rebuilt
         converged = converged and metrics.converged
@@ -459,6 +482,15 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
         "precompile_s": round(precompile_s, 4),
         "wave_p50_s": round(float(np.percentile(wave_lat, 50)), 4),
         "churn_p50_s": round(float(np.percentile(churn_lat, 50)), 4),
+        # Per-round device-work series (bench_compare gates these as
+        # counts, machine-independent — wall time alone can hide a
+        # device-work regression behind host overlap and vice versa).
+        "wave_solve_iters": wave_solve_iters,
+        "wave_bf_sweeps": wave_bf_sweeps,
+        "wave_device_calls": wave_device_calls,
+        "wave_entry_phase": wave_entry_phase,
+        "churn_solve_iters": churn_solve_iters,
+        "churn_device_calls": churn_device_calls,
         "churn_delta_hits": churn_delta_hits,
         "churn_rows_rebuilt": churn_rows_rebuilt,
         "churn_cols_rebuilt": churn_cols_rebuilt,
@@ -897,6 +929,16 @@ def build_artifact(rungs, target, parity, trace, features) -> dict:
             # checkpoint; the reference has no counterpart).
             "restart_s": best.get("restart_round_s"),
         })
+        # Per-round DEVICE-WORK series of the scored rung, lifted to the
+        # top level so tools/bench_compare.py gates them from wrapper
+        # artifacts too (they are machine-independent — the wall timings
+        # above are not).
+        for key in ("wave_solve_iters", "wave_bf_sweeps",
+                    "wave_device_calls", "wave_entry_phase",
+                    "churn_solve_iters", "churn_device_calls",
+                    "churn_delta_hits"):
+            if key in best:
+                out[key] = best[key]
     return out
 
 

@@ -88,3 +88,18 @@ def weak_float(xs):
 
 def suppressed_float(xs):
     return kernel(xs, 1.5, scale=2)  # posecheck: ignore[retrace-guard]
+
+
+@functools.partial(jax.jit, static_argnames=("max_iter",))
+def ladder_kernel(x, eps_sched, global_every, adaptive, *, max_iter):
+    return x * eps_sched[0] + global_every + adaptive
+
+
+def ladder_schedule_as_python_value(xs):
+    # VIOLATION: the epsilon-ladder / adaptive-cadence knobs are TRACED
+    # int32 operands in the production solve (transport._solve_device);
+    # a bool constant at the adaptive position mints a fresh executable
+    # per distinct value — the ladder-schedule-as-Python-value
+    # regression the wave smoke's budget-0 gate catches at runtime,
+    # linted red here statically.
+    return ladder_kernel(xs, xs, 4, True, max_iter=8192)

@@ -35,6 +35,7 @@ from poseidon_tpu.costmodel.base import CostModel
 from poseidon_tpu.graph.state import ClusterState
 from poseidon_tpu.ops.transport import (
     INF_COST,
+    NUM_PHASES,
     solve_transport,
     sparse_adm_cells,
 )
@@ -117,6 +118,17 @@ class RoundMetrics:
     # CONCURRENTLY with a band solve (graph/pipeline.py) — realized
     # overlap, not submitted work.
     pipeline_overlap_s: float = 0.0
+    # Device-ladder entry telemetry (the adaptive epsilon ladder): the
+    # WORST (lowest) entry phase across this round's band solves — 0
+    # means some solve ran the full cold ladder, transport.NUM_PHASES
+    # means every solve was answered without a device ladder at all
+    # (rounds that ran no band solve — quiet / zero-machine — report
+    # NUM_PHASES too).
+    ladder_entry_phase: int = 0
+    # Per-epsilon-phase iteration split summed across the round's band
+    # solves (length transport.NUM_PHASES; [] when nothing solved) —
+    # the device-work decomposition the bench wave series gates on.
+    solve_phase_iters: list = field(default_factory=list)
     # Which tier of the degraded-mode ladder served the round (worst
     # band wins): "pruned" (shortlist + full-plane certificate),
     # "dense" (full-plane solve), "host_greedy" (the last-resort
@@ -430,6 +442,8 @@ class RoundPlanner:
         self._cost_rows_rebuilt = 0
         self._cost_cols_rebuilt = 0
         self._pipeline_overlap = 0.0
+        self._entry_phase_min = -1
+        self._phase_iter_sums = None
         # Worst degraded-mode tier used this round (index into _TIERS).
         self._tier_rank = -1
         # Chaos seam (poseidon_tpu/chaos): when set, an object whose
@@ -605,20 +619,54 @@ class RoundPlanner:
                         solve_transport_coarse_fused,
                     )
 
-                    probe_c = rng.integers(
-                        0, hint + 1, size=(e_bucket, m_bucket)
-                    ).astype(np.int32)
-                    solve_transport_coarse_fused(
-                        probe_c, np.ones(e_bucket, dtype=np.int32),
-                        np.ones(m_bucket, dtype=np.int32),
-                        np.full(e_bucket, hint, dtype=np.int32),
-                        arc_capacity=np.ones(
-                            (e_bucket, m_bucket), dtype=np.int32
-                        ),
-                        max_cost_hint=hint, max_iter_total=8192,
-                        force=True,
+                    # One probe loop for the fused coarse keys this
+                    # bucket can mint: the full width (scale derived in
+                    # force mode, as production's dense planes do) PLUS
+                    # the pinned-scale REDUCED widths the wave-shaped
+                    # prune gate opens (transport_pruned.row_gate_ok
+                    # lets few-row very-wide bands solve at
+                    # quarter-octave reduced widths, where the fused
+                    # pipeline fires at the FULL bucket's pinned scale
+                    # — a (shape, groups, block, scale) compile key the
+                    # full-width probe never warms, so the first
+                    # qualifying wave band would otherwise pay a fresh
+                    # mid-round fused compile through the tunnel).  The
+                    # probed reduced widths are the prune landing zone:
+                    # the covering union targets 2x supply, landing at
+                    # <= half width (the measured 10k wave prunes to
+                    # m_bucket/4); widths missed (plane-dependent
+                    # buckets) still compile only once and ride the
+                    # persistent cache.
+                    from poseidon_tpu.ops.transport_pruned import (
+                        PRUNE_WAVE_MIN_COLS,
+                        row_gate_ok,
                     )
-                    compiled += 1
+
+                    probe_widths = [(m_bucket, None)]
+                    if (e_bucket <= 64
+                            and m_bucket >= PRUNE_WAVE_MIN_COLS
+                            and row_gate_ok(e_bucket, m_bucket, 1 << 30)):
+                        probe_widths += [
+                            (w, scale_full)
+                            for w in sorted({m_bucket // 4,
+                                             m_bucket // 2})
+                            if w >= COARSE_MIN_MACHINES
+                        ]
+                    for width, pinned in probe_widths:
+                        probe_c = rng.integers(
+                            0, hint + 1, size=(e_bucket, width)
+                        ).astype(np.int32)
+                        solve_transport_coarse_fused(
+                            probe_c, np.ones(e_bucket, dtype=np.int32),
+                            np.ones(width, dtype=np.int32),
+                            np.full(e_bucket, hint, dtype=np.int32),
+                            arc_capacity=np.ones(
+                                (e_bucket, width), dtype=np.int32
+                            ),
+                            max_cost_hint=hint, max_iter_total=8192,
+                            force=True, scale=pinned,
+                        )
+                        compiled += 1
                 for width, scale in widths:
                     costs = rng.integers(
                         0, hint + 1, size=(e_bucket, width)
@@ -692,6 +740,7 @@ class RoundPlanner:
                 pruned_price_out_rounds=metrics.pruned_price_out_rounds,
                 pruned_escalations=metrics.pruned_escalations,
                 pruned_cert_accepts=metrics.pruned_cert_accepts,
+                ladder_entry_phase=metrics.ladder_entry_phase,
                 cost_delta_hits=metrics.cost_delta_hits,
                 cost_rows_rebuilt=metrics.cost_rows_rebuilt,
                 cost_cols_rebuilt=metrics.cost_cols_rebuilt,
@@ -725,6 +774,7 @@ class RoundPlanner:
             metrics.gap_bound = m.gap_bound
             metrics.converged = m.converged
             metrics.solve_tier = "quiet"
+            metrics.ladder_entry_phase = NUM_PHASES  # no device ladder ran
             st.round_index += 1
             metrics.total_seconds = time.perf_counter() - t0
             self.last_metrics = metrics
@@ -1090,6 +1140,7 @@ class RoundPlanner:
                 (self.cost_model.build(ecs, mt).unsched_cost.astype(np.int64)
                  * ecs.supply.astype(np.int64)).sum()
             )
+            metrics.ladder_entry_phase = NUM_PHASES  # no device ladder ran
             return flows_full
 
         bands = self._band_of_rows(ecs, mt)
@@ -1119,6 +1170,8 @@ class RoundPlanner:
         self._cost_cols_rebuilt = 0
         self._pipeline_overlap = 0.0
         self._tier_rank = -1
+        self._entry_phase_min = -1
+        self._phase_iter_sums = None
         remaining = sorted(set(bands.tolist()))
         if len(remaining) > 1:
             chained = self._try_chained_wave(
@@ -1195,6 +1248,18 @@ class RoundPlanner:
             gap = max(gap, sol.gap_bound)
             iters += sol.iterations
             metrics.bf_sweeps += sol.bf_sweeps
+            ep = int(sol.entry_phase)
+            self._entry_phase_min = (
+                ep if self._entry_phase_min < 0
+                else min(self._entry_phase_min, ep)
+            )
+            if sol.phase_iters:
+                if self._phase_iter_sums is None:
+                    self._phase_iter_sums = [0] * len(sol.phase_iters)
+                self._phase_iter_sums = [
+                    a + int(b)
+                    for a, b in zip(self._phase_iter_sums, sol.phase_iters)
+                ]
             flows_full[idx] = sol.flows
 
             fl = sol.flows.astype(np.int64)
@@ -1223,6 +1288,14 @@ class RoundPlanner:
         metrics.cost_rows_rebuilt = self._cost_rows_rebuilt
         metrics.cost_cols_rebuilt = self._cost_cols_rebuilt
         metrics.pipeline_overlap_s = round(self._pipeline_overlap, 6)
+        # -1 sentinel = no band solve ran at all: report NUM_PHASES
+        # ("no device ladder"), not 0 ("full cold ladder ran").
+        metrics.ladder_entry_phase = (
+            self._entry_phase_min if self._entry_phase_min >= 0
+            else NUM_PHASES
+        )
+        if self._phase_iter_sums is not None:
+            metrics.solve_phase_iters = list(self._phase_iter_sums)
         if self._tier_rank >= 0:
             metrics.solve_tier = self._TIERS[self._tier_rank]
         return flows_full
@@ -1396,6 +1469,18 @@ class RoundPlanner:
         metrics.iterations = sol1.iterations + sol2.iterations
         metrics.bf_sweeps = sol1.bf_sweeps + sol2.bf_sweeps
         metrics.solve_tier = "dense"  # the chained wave is a full-plane solve
+        # Entry/phase telemetry for the chained early return (the
+        # banded loop's aggregation below never runs): same min/sum
+        # semantics over the two band solutions.
+        metrics.ladder_entry_phase = min(
+            int(sol1.entry_phase), int(sol2.entry_phase)
+        )
+        if sol1.phase_iters or sol2.phase_iters:
+            p1 = list(sol1.phase_iters) or [0] * len(sol2.phase_iters)
+            p2 = list(sol2.phase_iters) or [0] * len(p1)
+            metrics.solve_phase_iters = [
+                int(a) + int(b) for a, b in zip(p1, p2)
+            ]
         if self.incremental:
             for key_band, ecs_b, sol, costs_b, unsched_b in (
                 (int(remaining[0]), ecs_1, sol1, cm1.costs,
@@ -1525,13 +1610,26 @@ class RoundPlanner:
                 prices = flows0 = unsched0 = None
         warm_state = (prices, flows0, unsched0, eps_start)
 
+        carry_box: dict = {}
         out = self._try_pruned_band(band, ecs_b, cm, col_cap,
-                                    machine_uuids, warm_state)
+                                    machine_uuids, warm_state,
+                                    carry_box)
         tier = "pruned"
         if out is None:
+            # Escalations hand the dense path the last certified reduced
+            # solve's LIFTED full-plane state (prices/flows + the exact
+            # eps it is eps-CS at) instead of restarting the band from
+            # the stale warm frame / cold coarse pipeline — the pruned
+            # attempt's device work then seeds the dense ladder rather
+            # than being thrown away (gated with the adaptive ladder:
+            # POSEIDON_ADAPTIVE_LADDER=0 restores the exact old restart).
             out = self._solve_plane(
                 ecs_b, cm.costs, col_cap, cm.arc_capacity,
-                cm.unsched_cost, warm_state,
+                cm.unsched_cost, carry_box.get("warm", warm_state),
+                # The carry's eps is EXACT (the lift measured it), so
+                # the dense solve skips the host-cert pass that would
+                # recompute it and miss.
+                warm_eps_exact="warm" in carry_box,
             )
             tier = "dense"
         sol, effective_costs = out
@@ -1568,7 +1666,7 @@ class RoundPlanner:
         return sol
 
     def _try_pruned_band(self, band, ecs_b, cm, col_cap, machine_uuids,
-                         warm_state):
+                         warm_state, carry_box=None):
         """Pruned-plane attempt (ops/transport_pruned): run the band's
         pipeline — coarse start, warm dispatch — on the union of
         per-row cheapest-column shortlists, certify the lifted solution
@@ -1695,6 +1793,18 @@ class RoundPlanner:
                     self._hidden_iters += prev.iterations
                     self._hidden_bf += prev.bf_sweeps
                 self._pruned_escalations += 1
+                if (carry_box is not None
+                        and stats.get("carry") is not None
+                        and eff_base is cm.costs
+                        and os.environ.get(
+                            "POSEIDON_ADAPTIVE_LADDER", "1") != "0"):
+                    # Seed the dense fallback with the last lifted
+                    # full-plane state (certified eps-CS at its recorded
+                    # eps) — only while NO gang rows were forbidden yet:
+                    # the dense path re-runs repair from the base plane,
+                    # and a carry priced for forbidden rows would be a
+                    # poisoned start once those rows re-open.
+                    carry_box["warm"] = stats["carry"]
                 return None
             if prev is not None:
                 # The replaced (pre-repair) solve's work, as in the
@@ -1755,7 +1865,9 @@ class RoundPlanner:
         uuids, k = saved
         E = int(ecs_b.supply.size)
         M = int(col_cap.size)
-        if (E < tp._env_int("POSEIDON_PRUNE_MIN_ROWS", tp.PRUNE_MIN_ROWS)
+        if (not tp.row_gate_ok(
+                E, M, tp._env_int("POSEIDON_PRUNE_MIN_ROWS",
+                                  tp.PRUNE_MIN_ROWS))
                 or M < tp._env_int("POSEIDON_PRUNE_MIN_COLS",
                                    tp.PRUNE_MIN_COLS)):
             return None
@@ -1794,7 +1906,7 @@ class RoundPlanner:
 
     def _solve_plane(self, ecs_b, costs, col_cap, arc_capacity,
                      unsched_cost, warm_state, scale=None,
-                     gang_repair=True):
+                     gang_repair=True, warm_eps_exact=False):
         """The per-plane solve pipeline: coarse warm start, warm/cold
         dispatch with policy budgets, gang-atomicity repair.  Factored
         out of ``_solve_band`` so the pruned path can run the IDENTICAL
@@ -1810,6 +1922,12 @@ class RoundPlanner:
         are optimal for (gang repair may have forbidden rows)."""
         prices, flows0, unsched0, eps_start = warm_state
         sol = None
+        # True when eps_start is the start's EXACT certified epsilon
+        # (the coarse lift computes it with _certified_eps; an
+        # escalation carry arrives pre-certified via warm_eps_exact):
+        # the pre-dispatch host certificate would then miss by
+        # construction and solve_transport skips the O(E*M) attempt.
+        eps_is_exact = warm_eps_exact
         if (prices is None and self.flow_solver != "ssp"
                 and os.environ.get("POSEIDON_COARSE", "1") != "0"):
             # Fresh-wave coarse start: solve the machine-AGGREGATED
@@ -1844,11 +1962,19 @@ class RoundPlanner:
             if pre is not None:
                 if (self.solver_devices == 1
                         and not pre["certified"]
-                        and scale is None
+                        and (scale is None or os.environ.get(
+                            "POSEIDON_COARSE_PINNED", "1") != "0")
                         and accel_policy("POSEIDON_COARSE_FUSED")):
-                    # Dense planes only (scale is None): the fused
-                    # pipeline derives its own scale internally, which
-                    # must not diverge from a pruned plane's pinned one.
+                    # Pinned-scale planes (the pruned path solves
+                    # reduced planes at the FULL instance's scale) run
+                    # the fused pipeline too: the ``pre`` bundle already
+                    # carries the pinned scale, so the fused program
+                    # solves at it rather than deriving a divergent one.
+                    # This is the `scale is None` gate that disabled the
+                    # fused coarse start on every reduced wave band (the
+                    # negative POSEIDON_PRUNE_MIN_ROWS=48 experiment,
+                    # docs/PERF.md round 8); POSEIDON_COARSE_PINNED=0
+                    # restores it.
                     from poseidon_tpu.ops.transport_coarse import (
                         solve_transport_coarse_fused,
                     )
@@ -1881,8 +2007,9 @@ class RoundPlanner:
                     )
                     if cs is not None:
                         prices, flows0, unsched0, eps_start = cs
+                        eps_is_exact = True
 
-        def run(run_costs, eps, p=None, f=None, u=None):
+        def run(run_costs, eps, p=None, f=None, u=None, exact=False):
             # Policy iteration budgets (the kernel default is a pure
             # backstop): a warm attempt that has not converged within a
             # few times a typical warm solve (~200-500 iterations) is
@@ -1904,11 +2031,12 @@ class RoundPlanner:
                 # The model's static bound pins the cost scale (a compile
                 # key) regardless of per-round cost drift.
                 max_cost_hint=self.cost_model.max_cost(),
-                scale=scale,
+                scale=scale, eps_exact=exact,
             )
 
         if sol is None:
-            sol = run(costs, eps_start, prices, flows0, unsched0)
+            sol = run(costs, eps_start, prices, flows0, unsched0,
+                      exact=eps_is_exact)
             if prices is not None and sol.gap_bound == float("inf"):
                 # Any warm start can mislead (drift heuristic missed
                 # deep churn, or a poisoned carried frame): retry cold.

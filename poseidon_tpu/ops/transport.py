@@ -157,6 +157,15 @@ class TransportSolution:
     iterations: int         # total push/relabel iterations across phases
     bf_sweeps: int = 0      # Bellman-Ford sweeps inside global updates
     phase_iters: tuple = () # per-epsilon-phase iteration split (diagnostic)
+    # Exact certified epsilon of the returned state (_certified_eps in
+    # _host_finalize; 0 = not computed, e.g. non-converged states).  The
+    # adaptive ladder reads it off rejected host-cert candidates to
+    # enter the device ladder at the start's TRUE violation.
+    eps_certified: int = 0
+    # How many rungs of the cold epsilon ladder the start skipped
+    # (0 = full cold ladder, NUM_PHASES = answered with no device
+    # ladder at all) — the "ladder entry phase" telemetry series.
+    entry_phase: int = 0
 
 
 def _relabel_to(maxcand, has_adm, excess, p, eps):
@@ -177,24 +186,96 @@ def _relabel_to(maxcand, has_adm, excess, p, eps):
 
 _DINF = 1 << 24  # "unreached" marker for global-update distances
 
+# Adaptive global-update cadence (POSEIDON_ADAPTIVE_BF): the BF global
+# update is the kernel's dominant per-iteration op-count term
+# (docs/PERF.md), yet during a healthy drain — active excess halving
+# between updates — the local relabels alone keep the phase moving and
+# the update is mostly redundant re-aiming.  The schedule widens the
+# update gap (x2 per well-decayed window, capped) while progress holds
+# and snaps back to the base cadence the moment it stalls, so the
+# non-convergent no-update regime is unreachable.  The cap is deliberately
+# modest: the round-4/5 sweeps measured fixed cadences 8/16 LOSING on
+# iterations; the adaptive gap only widens while the iterate is
+# demonstrably not paying that price.
+_ADAPT_GAP_CAP = 4  # max widened gap = global_every * this
+
+
+def _gu_fire(adaptive, it, next_gu, global_every):
+    """Does iteration ``it`` run the global update?  Fixed cadence when
+    ``adaptive`` (traced int32) is 0 — bit-identical to the historical
+    ``it % global_every == 0`` — else the excess-decay schedule.  ONE
+    definition shared by the lax, fused, and tiled implementations so
+    their bit-parity survives the adaptive path."""
+    return jnp.where(
+        adaptive > 0, it >= next_gu, it % global_every == 0
+    )
+
+
+def _active_excess(exc_e, exc_m, exc_t):
+    """Total ACTIVE (positive) excess — the adaptive cadence's progress
+    signal.  Shape-agnostic (the fused/tiled kernels carry 2-D excess
+    planes) and shared like _gu_fire/_gu_advance so the three
+    implementations cannot drift apart on it.  int32-safe: positive
+    excess is bounded by total supply, validated < 2^31."""
+    return (
+        jnp.sum(jnp.maximum(exc_e, 0))
+        + jnp.sum(jnp.maximum(exc_m, 0))
+        + jnp.maximum(exc_t, 0)
+    )
+
+
+def _gu_advance(fired, tot_excess, it, next_gu, gap, last_exc,
+                global_every):
+    """Adaptive-schedule state transition, applied after the fire
+    decision.  ``tot_excess`` is the total ACTIVE excess entering this
+    iteration; a window that at least halved it earns a doubled gap
+    (capped), anything else resets to the base cadence.  Shared by all
+    three kernel implementations (see _gu_fire)."""
+    # Overflow-safe halving test (equivalent to 2*tot <= last for
+    # non-negative ints): total active excess is bounded by total
+    # supply, which _host_validate only bounds below 2^31 — doubling it
+    # could wrap int32 and spuriously widen the gap exactly when excess
+    # is largest.
+    decayed = tot_excess <= last_exc // 2
+    gap_f = jnp.where(
+        decayed,
+        jnp.minimum(gap * 2, global_every * _ADAPT_GAP_CAP),
+        global_every,
+    )
+    return (
+        jnp.where(fired, it + gap_f, next_gu),
+        jnp.where(fired, gap_f, gap),
+        jnp.where(fired, tot_excess, last_exc),
+    )
+
+
 def iter_unroll() -> int:
     """Main-loop iterations per lax.while_loop step (see _pr_phase).
 
-    4 matches the default global-update cadence so each group carries
-    exactly one global-update candidate slot.  POSEIDON_ITER_UNROLL
+    On accelerators 4 matches the default global-update cadence so each
+    group carries exactly one global-update candidate slot — the
+    loop-step sync cost it amortizes is the whole point there.  On CPU
+    the sync cost is negligible while the group TAIL is not: a group
+    runs up to unroll-1 structurally-no-op sub-iterations past
+    convergence, and at the coarse-warmed wave's ~80-iteration
+    full-width solves that tail measured ~7-10% of solve wall
+    (docs/PERF.md round 9) — so CPU defaults to 1.  POSEIDON_ITER_UNROLL
     overrides for per-backend tuning — read at CALL (trace) time, not
     import time, so tests/bench can vary it per solve; note the value
     is baked into each traced program, so a change takes effect on the
     next fresh trace (new compile key or ``jax.clear_caches()``), never
-    by mutating an already-compiled executable.
+    by mutating an already-compiled executable.  Semantics are unroll-
+    invariant either way (budgets, telemetry, and results are exact —
+    the `active` gate freezes no-op sub-iterations).
     """
+    default = 4 if jax.default_backend() in ACCEL_PLATFORMS else 1
     try:
         # int() of an env string at TRACE time, never of a tracer (the
         # closure pulls this helper into jit scope via _pr_phase).
-        raw = os.environ.get("POSEIDON_ITER_UNROLL", "4")
+        raw = os.environ.get("POSEIDON_ITER_UNROLL", str(default))
         return max(1, int(raw))  # posecheck: ignore[jit-purity]
     except ValueError:
-        return 4
+        return default
 
 
 def _global_update(F, Ffb, Fmt, pe, pm, pt, exc_e, exc_m, exc_t,
@@ -321,7 +402,7 @@ def _excesses(F, Ffb, Fmt, *, supply, total):
 
 
 def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
-              max_iter_total, global_every, bf_max):
+              max_iter_total, global_every, bf_max, adaptive):
     """One epsilon phase: refine the carried flows to the new eps, then
     synchronous push/relabel until every excess is zero.
 
@@ -361,7 +442,7 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
         return _excesses(F, Ffb, Fmt, supply=supply, total=total)
 
     def cond(st):
-        _F, _Ffb, _Fmt, exc, _pe, _pm, _pt, it, _bf = st
+        _F, _Ffb, _Fmt, exc, _pe, _pm, _pt, it, _bf, _gu = st
         exc_e, exc_m, exc_t = exc
         active = jnp.any(exc_e > 0) | jnp.any(exc_m > 0) | (exc_t > 0)
         return (
@@ -371,8 +452,13 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
         )
 
     def iterate(st):
-        F, Ffb, Fmt, exc, pe, pm, pt, it, bf = st
+        F, Ffb, Fmt, exc, pe, pm, pt, it, bf, gu_state = st
         exc_e, exc_m, exc_t = exc
+        next_gu, gu_gap, last_exc = gu_state
+        # Pre-push ACTIVE excess — the adaptive cadence's progress
+        # signal (two small-vector reductions, noise next to the
+        # [E, M] push work).
+        tot_excess = _active_excess(exc_e, exc_m, exc_t)
         # Unrolled-group no-op gate: after mid-group convergence every
         # push/relabel below is structurally zero (all gated on positive
         # excess), so the only state this must freeze is the iteration
@@ -506,27 +592,35 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
                 admissible_arcs=admissible_arcs, eps=eps, bf_max=bf_max,
             )
 
-        # Every global_every-th sweep: global update (redirects everything
-        # at deficits); otherwise the cheap local relabel.  Measured sweep
-        # (full-wave 1k/10k, churn 10k/100k): cadence 4 beats 8/16 on the
-        # heavy wave case (358 vs 412/447 iterations); disabling the
-        # update entirely does not converge in any reasonable budget, and
-        # two stall-adaptive triggers (excess non-decreasing / <1/8
-        # progress since last update) both degenerated on real instances
-        # — trickling progress defeats the former, plateaus the latter.
-        # Cadence is a traced operand: iteration count and wall time trade
-        # off differently per backend (the BF sweeps dominate op count),
-        # so the planner can tune it without minting compile keys.
+        # Global update on the configured cadence — fixed every
+        # global_every-th sweep (measured: 4 beats 8/16 on the heavy
+        # wave case, 358 vs 412/447 iterations; no updates at all is
+        # non-convergent), or, under the ADAPTIVE schedule (traced
+        # ``adaptive`` operand, POSEIDON_ADAPTIVE_BF), widened while the
+        # active excess keeps halving between updates and snapped back
+        # to the base cadence on any stall (_gu_fire/_gu_advance — the
+        # historical stall-adaptive triggers failed because they could
+        # STARVE the update on trickling progress; this schedule can
+        # only ever delay it while progress is measured, and the decay
+        # test resets it the moment progress is not).  Cadence and the
+        # adaptive flag are traced operands: no compile keys minted.
+        fired = _gu_fire(adaptive, it, next_gu, global_every) & active
         pe_new, pm_new, pt_new, sweeps = lax.cond(
-            (it % global_every == 0) & active,
-            global_up, local_relabel, operand=None,
+            fired, global_up, local_relabel, operand=None,
+        )
+        gu_state_new = _gu_advance(
+            fired, tot_excess, it, next_gu, gu_gap, last_exc,
+            global_every,
         )
 
         # Inactive sub-iterations freeze the state EXACTLY.  Convergence
         # makes the updates above structurally zero, but budget
         # exhaustion does not (excess remains, pushes/relabels would
         # fire) — the select is what makes the gate sound for both.
-        F_in, Ffb_in, Fmt_in, exc_in, pe_in, pm_in, pt_in, _it, _bf = st
+        # (gu_state needs no select: _gu_advance only moves on ``fired``,
+        # which carries the same ``active`` gate.)
+        (F_in, Ffb_in, Fmt_in, exc_in, pe_in, pm_in, pt_in, _it, _bf,
+         _gu) = st
 
         def sel(new, old):
             return jnp.where(active, new, old)
@@ -535,7 +629,7 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
             sel(F, F_in), sel(Ffb, Ffb_in), sel(Fmt, Fmt_in),
             jax.tree_util.tree_map(sel, exc, exc_in),
             sel(pe_new, pe_in), sel(pm_new, pm_in), sel(pt_new, pt_in),
-            it + active.astype(jnp.int32), bf + sweeps,
+            it + active.astype(jnp.int32), bf + sweeps, gu_state_new,
         )
 
     # iter_unroll() iterations per while step: on TPU each lax.while_loop
@@ -553,8 +647,16 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
         return st
 
     exc0 = excesses(F, Ffb, Fmt)
-    init = (F, Ffb, Fmt, exc0, pe, pm, pt, jnp.int32(0), jnp.int32(0))
-    F, Ffb, Fmt, _exc, pe, pm, pt, iters, bf = lax.while_loop(
+    # Adaptive-cadence state: (next update iteration, current gap, total
+    # active excess at the last update).  next_gu=0 fires the first
+    # update at it=0 exactly like the fixed cadence; last_exc=0 makes
+    # the first window's decay test false (no widening before a
+    # measurement exists).
+    gu0 = (jnp.int32(0), jnp.asarray(global_every, jnp.int32),
+           jnp.int32(0))
+    init = (F, Ffb, Fmt, exc0, pe, pm, pt, jnp.int32(0), jnp.int32(0),
+            gu0)
+    F, Ffb, Fmt, _exc, pe, pm, pt, iters, bf, _gu = lax.while_loop(
         cond, body, init
     )
     return (
@@ -565,7 +667,7 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
 @functools.partial(jax.jit, static_argnames=("max_iter", "scale"))
 def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
                   init_flows, init_fb, eps_sched, max_iter_total,
-                  global_every, bf_max, *, max_iter, scale):
+                  global_every, bf_max, adaptive_bf=0, *, max_iter, scale):
     """The jitted solve.  All inputs int32; shapes static.
 
     costs: [E, M] raw costs (INF_COST where inadmissible)
@@ -581,6 +683,9 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
     global_every / bf_max: scalar int32, traced — global-update cadence and
       Bellman-Ford sweep cap (tuning knobs; values must not mint compile
       keys)
+    adaptive_bf: scalar int32, traced — 0 keeps the fixed global-update
+      cadence bit-exactly; nonzero enables the excess-decay-driven
+      schedule (_gu_fire/_gu_advance)
 
     Returns ``(F, Ffb, prices, iters, bf_sweeps, clean)``: ``clean`` is
     True iff the final state has zero excess everywhere — the exact
@@ -620,7 +725,7 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
     phase = functools.partial(
         _pr_phase, C=C, U=U, Uem=Uem, supply=supply, cap=cap, total=total,
         max_iter=max_iter, max_iter_total=max_iter_total,
-        global_every=global_every, bf_max=bf_max,
+        global_every=global_every, bf_max=bf_max, adaptive=adaptive_bf,
     )
     carry0 = (F0, Ffb0, Fmt0, pe, pm, pt, jnp.int32(0), jnp.int32(0))
     (F, Ffb, Fmt, pe, pm, pt, iters, bf), phase_iters = lax.scan(
@@ -700,7 +805,7 @@ def _solve_device_packed(big, vec, *, max_iter, scale, impl,
     buffers — ``big`` [3, E_pad, M_pad] int32 (costs, arc capacity,
     init flows) and ``vec`` 1-D int32 (supply | capacity | unsched cost
     | prices | fallback | eps schedule | max_iter_total, global_every,
-    bf_max) — and returns two (the flow matrix and one small vector:
+    bf_max, adaptive_bf) — and returns two (the flow matrix and one small vector:
     fallback | prices | iters, bf, clean, unchanged | per-phase
     iterations), so a solve costs 2 uploads + at most 2 fetches
     regardless of implementation (1 fetch when ``unchanged`` reports
@@ -722,9 +827,10 @@ def _solve_device_packed(big, vec, *, max_iter, scale, impl,
     max_iter_total = vec[o]
     global_every = vec[o + 1]
     bf_max = vec[o + 2]
+    adaptive_bf = vec[o + 3]
     args = (costs, supply, capacity, unsched_cost, arc_cap, init_prices,
             init_flows, init_fb, eps_sched, max_iter_total, global_every,
-            bf_max)
+            bf_max, adaptive_bf)
     if impl == "fused":
         from poseidon_tpu.ops.transport_fused import solve_device_fused
 
@@ -851,6 +957,19 @@ def accel_policy(env_var: str) -> bool:
     return jax.default_backend() in ACCEL_PLATFORMS
 
 
+def adaptive_bf_flag() -> int:
+    """The adaptive global-update cadence flag as the traced int32 the
+    kernels consume — ONE derivation for every wrapper (single-chip,
+    selective, sharded, fused coarse, chained), so a policy change can
+    never leave one path on the old schedule and silently break their
+    cross-path bit-parity.  Three-state accel policy: the BF sweeps the
+    schedule saves are sequential sync-bound while-steps (dominant on
+    the tunneled accelerator); on CPU it measured an op-count wash that
+    perturbs which equally-optimal equilibrium a solve lands on, so CPU
+    keeps the fixed cadence bit-exactly unless forced."""
+    return 1 if accel_policy("POSEIDON_ADAPTIVE_BF") else 0
+
+
 def _use_tiled(e_pad: int, m_pad: int) -> bool:
     """Route this solve through the tiled per-iteration Pallas kernel?
 
@@ -910,6 +1029,30 @@ LADDER_FACTOR = 4096
 NUM_PHASES = 4
 
 
+def eps_schedule(eps0: int) -> np.ndarray:
+    """The NUM_PHASES-rung descending epsilon ladder from ``eps0`` —
+    the one schedule rule (_host_validate derives through it; the
+    adaptive entry re-derives with a tightened eps0)."""
+    return np.asarray(
+        [max(1, int(eps0) // LADDER_FACTOR**k) for k in range(NUM_PHASES)],
+        dtype=np.int32,
+    )
+
+
+def ladder_entry_phase(eps0_cold: int, eps0: int) -> int:
+    """How many rungs of the cold ladder a start at ``eps0`` skips
+    (0 = full cold ladder; NUM_PHASES - 1 = entered at the exact rung).
+    The 'ladder entry phase' series in RoundMetrics / bench artifacts —
+    callers report NUM_PHASES for solves answered with no device ladder
+    at all (host-certificate returns)."""
+    k = 0
+    c = max(int(eps0_cold), 1)
+    for j in range(1, NUM_PHASES):
+        if eps0 <= max(c // LADDER_FACTOR**j, 1):
+            k = j
+    return k
+
+
 def derive_scale(costs, unsched_cost, max_cost_hint, num_ecs, num_machines):
     """The cost scale a solve of this instance will run at — the single
     source of truth shared by _host_validate (which applies it) and the
@@ -929,7 +1072,10 @@ def _host_validate(costs, supply, capacity, unsched_cost, scale, eps_start,
     """Input validation + scale/epsilon-schedule derivation (host side).
 
     Shared by the single-chip and mesh-sharded entry points.  Returns
-    ``(scale, eps_sched)``.  The scale is derived from the cost bound
+    ``(scale, eps_sched, eps0_cold)`` — ``eps0_cold`` is the epsilon a
+    COLD ladder of this instance starts at (``max_c // 2``), the
+    reference the adaptive entry-phase telemetry measures skipped rungs
+    against.  The scale is derived from the cost bound
     rounded UP to a power of two: jit treats the scale as a static
     argument, so per-round drift in the raw cost range must not mint
     fresh compile keys.  ``max_cost_hint`` (the cost model's static
@@ -979,11 +1125,7 @@ def _host_validate(costs, supply, capacity, unsched_cost, scale, eps_start,
         max_c // 2 if eps_start is None
         else max(1, min(int(eps_start), max_c // 2))
     )
-    eps_sched = np.asarray(
-        [max(1, eps0 // LADDER_FACTOR**k) for k in range(NUM_PHASES)],
-        dtype=np.int32
-    )
-    return scale, eps_sched
+    return scale, eps_schedule(eps0), max(max_c // 2, 1)
 
 
 def greedy_flows(costs, supply, capacity, arc_capacity=None) -> np.ndarray:
@@ -1668,6 +1810,7 @@ def _host_finalize(flows, unsched, prices, iters, *,
         raw[costs >= INF_COST] = 0
         objective = int((raw * flows.astype(np.int64)).sum()) + fb_cost
     n = E + M + 3
+    eps_actual = 0
     if not converged:
         gap_bound = float("inf")
     else:
@@ -1691,6 +1834,11 @@ def _host_finalize(flows, unsched, prices, iters, *,
         iterations=int(iters),
         bf_sweeps=int(bf_sweeps),
         phase_iters=phase_iters,
+        # The exact certified eps of THIS state (pre-normalize prices —
+        # normalization is a uniform shift, so reduced costs and the
+        # certificate are unchanged).  The adaptive ladder reads it off
+        # rejected host-cert candidates.
+        eps_certified=int(eps_actual),
     )
 
 
@@ -1769,8 +1917,16 @@ def solve_transport(
     global_update_every: int = 4,
     bf_max: int = 64,
     greedy_init: bool = True,
+    eps_exact: bool = False,
 ) -> TransportSolution:
     """Solve the EC->machine transportation problem on device.
+
+    ``eps_exact`` declares the caller's ``eps_start`` to be the start
+    state's EXACT certified epsilon (coarse lifts and pruned-path
+    carries compute it with ``_certified_eps`` themselves) rather than
+    a conservative drift bound: when it exceeds 1 the pre-dispatch host
+    certificate would recompute the same value and miss by
+    construction, so the O(E*M) attempt is skipped outright.
 
     Every unit of supply ends up either on a machine or on the per-EC
     unscheduled fallback arc, so the instance is always feasible and this
@@ -1846,7 +2002,7 @@ def solve_transport(
             max_cost_hint, E_pad, M_pad, scale=scale,
         )
     with _stage("solve.validate"):
-        scale, eps_sched = _host_validate(
+        scale, eps_sched, eps0_cold = _host_validate(
             costs_p, supply_p, capacity_p, unsched_p, scale, eps_start,
             max_cost_hint,
         )
@@ -1897,6 +2053,7 @@ def solve_transport(
         and init_unsched is not None
         and init_prices is not None
         and (was_warm or (eps_start is not None and eps_start <= 1))
+        and not (eps_exact and eps_start is not None and eps_start > 1)
         and os.environ.get("POSEIDON_HOST_CERT", "1") != "0"
     ):
         with _stage("solve.host_cert"):
@@ -1942,16 +2099,45 @@ def solve_transport(
                 flows=cand.flows.copy(), unsched=cand.unsched.copy(),
                 prices=cand.prices, objective=cand.objective,
                 gap_bound=0.0, iterations=0,
+                eps_certified=cand.eps_certified,
+                entry_phase=NUM_PHASES,
             )
+        if (
+            cand is not None
+            and not on_forbidden
+            and cand.gap_bound != float("inf")
+            and 1 < cand.eps_certified
+            and os.environ.get("POSEIDON_ADAPTIVE_LADDER", "1") != "0"
+        ):
+            # Adaptive ladder entry: the rejected certificate candidate
+            # already priced the start EXACTLY (its eps_certified is the
+            # worst reduced-cost violation over every arc class — the
+            # precise eps at which the shipped start satisfies
+            # eps-complementary-slackness), while the caller's eps_start
+            # is only a drift BOUND (|cost drift| * scale + 1) that can
+            # sit orders of magnitude above it.  Entering the ladder at
+            # the certified eps is sound by definition of eps-optimality
+            # and skips the rungs the bound would burn re-proving what
+            # the host just measured.  Repaired candidates are excluded:
+            # their certificate describes the repaired state, not the
+            # shipped one.  POSEIDON_ADAPTIVE_LADDER=0 restores the
+            # drift-bound entry bit-exactly.
+            if eps_start is None or cand.eps_certified < eps_start:
+                eps_start = int(min(cand.eps_certified, eps0_cold))
+                eps_sched = eps_schedule(max(eps_start, 1))
 
     if max_iter_total is None:
         max_iter_total = NUM_PHASES * max_iter_per_phase
     _Telemetry.device_calls += 1
+    # Adaptive global-update cadence — a traced operand, so flipping it
+    # never mints a compile key (policy rationale: adaptive_bf_flag).
+    adaptive_bf = adaptive_bf_flag()
     vec = np.concatenate([
         supply_p, capacity_p, unsched_p, prices_p, fb_p,
         np.asarray(eps_sched, dtype=np.int32),
         np.asarray(
-            [max_iter_total, global_update_every, bf_max], dtype=np.int32
+            [max_iter_total, global_update_every, bf_max, adaptive_bf],
+            dtype=np.int32,
         ),
     ])
     # Device-resident operand cache (accelerator backends): ship only
@@ -2068,13 +2254,17 @@ def solve_transport(
         prices_full[:E], prices_full[E_pad:E_pad + M],
         prices_full[E_pad + M_pad:],
     ])
-    return _host_finalize(
+    sol = _host_finalize(
         flows, unsched, prices_out, iters,
         costs=costs, supply=supply, capacity=capacity,
         unsched_cost=unsched_cost, scale=scale, clean=clean,
         arc_capacity=arc_capacity, bf_sweeps=bf,
         phase_iters=tuple(int(x) for x in phase_iters),
     )
+    # Telemetry: how many cold-ladder rungs the start skipped (the
+    # device ladder actually entered at eps_sched[0]).
+    sol.entry_phase = ladder_entry_phase(eps0_cold, int(eps_sched[0]))
+    return sol
 
 
 def _lift_excluded_prices(pe, pm_sel, pt, sel, *, costs, capacity, scale,
@@ -2152,6 +2342,11 @@ def solve_transport_selective(
     # greedy_init (forwarded explicitly below).
     pinned_scale = kw.pop("scale", None)
     greedy = kw.pop("greedy_init", True)
+    # The exactness declaration holds for the FULL instance's state
+    # only: a column-sliced reduced start can certify BELOW the full
+    # state's eps (fewer arcs), so the reduced solve must keep its
+    # host-certificate attempt.
+    eps_exact = kw.pop("eps_exact", False)
     # Pre-check state: on the gate-fail path the greedy start is handed
     # to the full-width fallback instead of being recomputed there.
     pre_state = None
@@ -2170,7 +2365,8 @@ def solve_transport_selective(
             costs, supply, capacity, unsched_cost, init_prices,
             arc_capacity=arc_capacity, init_flows=init_flows,
             init_unsched=init_unsched, max_cost_hint=max_cost_hint,
-            scale=pinned_scale, greedy_init=greedy, **kw,
+            scale=pinned_scale, greedy_init=greedy, eps_exact=eps_exact,
+            **kw,
         )
 
     k = int(supply.max(initial=0)) + slack
@@ -2324,4 +2520,5 @@ def solve_transport_selective(
         iterations=sol_r.iterations,
         bf_sweeps=sol_r.bf_sweeps,
         phase_iters=sol_r.phase_iters,
+        entry_phase=sol_r.entry_phase,
     )

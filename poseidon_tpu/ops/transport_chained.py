@@ -55,6 +55,7 @@ from poseidon_tpu.ops.transport import (
     _host_finalize,
     _host_validate,
     _Telemetry,
+    adaptive_bf_flag,
     coarse_sort_order,
     padded_shape,
 )
@@ -221,7 +222,8 @@ def _chained_wave_device(  # posecheck: ignore[dispatch-budget]
     mitA = vecA[o + 1]
     geA = vecA[o + 2]
     bfmaxA = vecA[o + 3]
-    o += 4
+    adaptiveA = vecA[o + 4]
+    o += 5
     reqA_cpu = vecA[o:o + E1]; o += E1                    # noqa: E702
     reqA_ram = vecA[o:o + E1]; o += E1                    # noqa: E702
 
@@ -229,7 +231,7 @@ def _chained_wave_device(  # posecheck: ignore[dispatch-budget]
      itc1, bfc1, _cc1, _eps1) = coarse_to_fine_band(
         bigA[0], bigA[1], capacityA, supplyA, unschedA, permA, invpermA,
         coarse3A[0], capgA, coarse3A[1], coarse3A[2], seedpA, seedfbA,
-        epsschedA, eps_capA, mitA, geA, bfmaxA,
+        epsschedA, eps_capA, mitA, geA, bfmaxA, adaptiveA,
         groups=K, block=B, max_iter=max_iter, scale=scale,
     )
 
@@ -254,6 +256,7 @@ def _chained_wave_device(  # posecheck: ignore[dispatch-budget]
     geB = intB[o + 2]
     bfmaxB = intB[o + 3]
     max_raw_qB = intB[o + 4]
+    adaptiveB = intB[o + 5]
     opsB["cpu_util"] = utilsB[0]
     opsB["mem_util"] = utilsB[1]
     opsB["measured_weight"] = utilsB[2, 0]
@@ -299,7 +302,7 @@ def _chained_wave_device(  # posecheck: ignore[dispatch-budget]
      itc2, bfc2, _cc2, _eps2) = coarse_to_fine_band(
         costsB, arcB, colB, supplyB, unschedB, permB, invpermB,
         CgB, capgB, arcgB, seed_f, seed_p, seed_fb,
-        eps_sched_cB, eps_capB, mitB, geB, bfmaxB,
+        eps_sched_cB, eps_capB, mitB, geB, bfmaxB, adaptiveB,
         groups=K, block=B, max_iter=max_iter, scale=scale,
     )
 
@@ -436,7 +439,7 @@ def solve_wave_chained(
         gfb_c = np.zeros(e1_pad, dtype=np.int32)
         gp_c = np.zeros(e1_pad + K + 1, dtype=np.int32)
         geps_c = None  # cold coarse ladder
-    _, eps_sched_cA = _host_validate(
+    _, eps_sched_cA, _ = _host_validate(
         CgA, supply1_p, capgA, unsched1_p, scale, geps_c, max_cost_hint
     )
     finiteA = bigA[0][bigA[0] < INF_COST]
@@ -449,6 +452,10 @@ def solve_wave_chained(
         np.asarray([
             max(max_cA // 2, 1),
             max(max_iter_total // 2, 1), global_update_every, bf_max,
+            # Same call-time adaptive-cadence policy as the per-band
+            # wrappers (traced operand) — the chained A/B arm must
+            # measure the same schedule the per-band path runs.
+            adaptive_bf_flag(),
         ], dtype=np.int32),
         pad_band_req(req1_cpu, e1_pad), pad_band_req(req1_ram, e1_pad),
     ])
@@ -546,6 +553,7 @@ def solve_wave_chained(
         np.asarray([
             eps0, max(max_iter_total // 2, 1), global_update_every,
             bf_max, max_raw_q,
+            adaptive_bf_flag(),
         ], dtype=np.int32),
     ]).astype(np.int32)
     utilsB = np.zeros((3, M2), dtype=np.float32)

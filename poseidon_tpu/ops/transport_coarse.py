@@ -131,7 +131,7 @@ def _coarse_fused_device(big, coarse3, vec,
     blocks) | coarse capacity | coarse seed prices (zeros + cold ladder
     when the greedy gate declined) | coarse seed fallback | the coarse
     epsilon ladder | [eps_cap (max_c // 2, the full ladder's clamp),
-    max_iter_total, global_every, bf_max].  Returns the flow matrix
+    max_iter_total, global_every, bf_max, adaptive_bf].  Returns the flow matrix
     plus one packed vector (fallback | prices | 7 scalars | per-phase
     iterations)."""
     _, E, M = big.shape
@@ -155,13 +155,14 @@ def _coarse_fused_device(big, coarse3, vec,
     max_iter_total = vec[o + 1]
     global_every = vec[o + 2]
     bf_max = vec[o + 3]
+    adaptive_bf = vec[o + 4]
 
     (F, Ffb, prices, iters, bf, clean, phase_iters,
      it_c, bf_c, clean_c, eps) = coarse_to_fine_band(
         costs, arc_cap, capacity, supply, unsched_cost, perm, inv_perm,
         Cg, capg, arcg, seed_flows, seed_prices, seed_fb,
         eps_sched_coarse, eps_cap, max_iter_total, global_every, bf_max,
-        groups=K, block=B, max_iter=max_iter, scale=scale,
+        adaptive_bf, groups=K, block=B, max_iter=max_iter, scale=scale,
     )
     small = jnp.concatenate([
         Ffb.astype(jnp.int32),
@@ -181,7 +182,7 @@ def coarse_to_fine_band(costs, arc_cap, capacity, supply, unsched_cost,
                         perm, inv_perm, Cg, capg, arcg, seed_flows,
                         seed_prices, seed_fb, eps_sched_coarse, eps_cap,
                         max_iter_total, global_every, bf_max,
-                        *, groups, block, max_iter, scale):
+                        adaptive_bf=0, *, groups, block, max_iter, scale):
     """The coarse->lift->disaggregate->certify->full-ladder pipeline as
     a plain traced function over already-unpacked operands.
 
@@ -203,7 +204,7 @@ def coarse_to_fine_band(costs, arc_cap, capacity, supply, unsched_cost,
         Cg, supply, capg, unsched_cost, arcg,
         seed_prices, seed_flows, seed_fb,
         eps_sched_coarse, max_iter_total, global_every, bf_max,
-        max_iter=max_iter, scale=scale,
+        adaptive_bf, max_iter=max_iter, scale=scale,
     )
 
     # ---- dual lift: group potential broadcast to members, back to the
@@ -272,7 +273,7 @@ def coarse_to_fine_band(costs, arc_cap, capacity, supply, unsched_cost,
         costs, supply, capacity, unsched_cost, arc_cap,
         lifted, F0, fb0, eps_sched,
         jnp.maximum(max_iter_total - it_c, 1), global_every, bf_max,
-        max_iter=max_iter, scale=scale,
+        adaptive_bf, max_iter=max_iter, scale=scale,
     )
     return (F, Ffb, prices, iters, bf, clean, phase_iters,
             it_c, bf_c, clean_c, eps)
@@ -293,6 +294,7 @@ def solve_transport_coarse_fused(
     groups: Optional[int] = None,
     pre=None,
     force: bool = False,
+    scale: Optional[int] = None,
 ) -> Optional[TransportSolution]:
     """One-dispatch coarse-to-fine wave solve, or ``None`` to decline.
 
@@ -302,7 +304,11 @@ def solve_transport_coarse_fused(
     solve is the fallback; the failure is rare and the retry honest).
     ``pre`` is a `transport.coarse_precheck` bundle — the planner
     computes it once so a fused decline does not redo the O(E*M) host
-    work in the fallback path.
+    work in the fallback path.  ``scale`` pins the cost scale (the
+    pruned path solves reduced planes at the FULL instance's scale and
+    must not let the fused program derive a divergent one); with a
+    ``pre`` bundle the pin is already inside it, so the argument mainly
+    serves ``force`` (precompile probing the pinned-scale compile keys).
     """
     costs = np.asarray(costs, dtype=np.int32)
     supply = np.asarray(supply, dtype=np.int32)
@@ -320,14 +326,15 @@ def solve_transport_coarse_fused(
 
         e_pad, m_pad = padded_shape(E, M)
         K = coarse_group_count(m_pad, groups)
-        scale, _ = derive_scale(
-            costs, unsched_cost, max_cost_hint, e_pad, m_pad
-        )
+        if scale is None:
+            scale, _ = derive_scale(
+                costs, unsched_cost, max_cost_hint, e_pad, m_pad
+            )
     else:
         if pre is None:
             pre = coarse_precheck(
                 costs, supply, capacity, arc_capacity, unsched_cost,
-                max_cost_hint, groups,
+                max_cost_hint, groups, scale=scale,
             )
         if pre is None:
             return None
@@ -367,7 +374,7 @@ def solve_transport_coarse_fused(
     # flow-mass headroom for the full-width push cumsums): the fused
     # path runs the unclipped full instance in its second stage, so an
     # aggregated-only check would silently skip them.
-    _host_validate(
+    _, _, eps0_cold = _host_validate(
         costs_p, supply_p, capacity_p, unsched_p, scale, None,
         max_cost_hint,
     )
@@ -388,7 +395,7 @@ def solve_transport_coarse_fused(
     if gp_c is None:
         gp_c = np.zeros(e_pad + K + 1, dtype=np.int32)
         geps_c = None  # cold ladder below
-    _, eps_sched_coarse = _host_validate(
+    _, eps_sched_coarse, _ = _host_validate(
         Cg_h, supply_p, capg_h, unsched_p, scale, geps_c, max_cost_hint,
     )
     finite = costs_p[costs_p < INF_COST]
@@ -401,6 +408,9 @@ def solve_transport_coarse_fused(
         max_iter_total = max_iter_per_phase
 
     _Telemetry.device_calls += 1
+    from poseidon_tpu.ops.transport import adaptive_bf_flag
+
+    adaptive_bf = adaptive_bf_flag()
     coarse3 = np.empty((3, e_pad, K), dtype=np.int32)
     coarse3[0] = Cg_h
     coarse3[1] = arcg_h
@@ -411,7 +421,7 @@ def solve_transport_coarse_fused(
         np.asarray(eps_sched_coarse, dtype=np.int32),
         np.asarray(
             [max(max_c // 2, 1), max_iter_total, global_update_every,
-             bf_max],
+             bf_max, adaptive_bf],
             dtype=np.int32,
         ),
     ])
@@ -469,4 +479,11 @@ def solve_transport_coarse_fused(
     )
     if sol.gap_bound == float("inf"):
         return None  # rare: callers retry the ordinary path honestly
+    # Entry telemetry: the in-program full ladder started at the lift's
+    # certified eps (capped at the cold eps0 exactly like the host path).
+    from poseidon_tpu.ops.transport import ladder_entry_phase
+
+    sol.entry_phase = ladder_entry_phase(
+        eps0_cold, max(1, min(int(eps), int(eps0_cold)))
+    )
     return sol

@@ -39,6 +39,9 @@ from poseidon_tpu.ops.transport import (
     _POS,
     INF_COST,
     NUM_PHASES,
+    _active_excess,
+    _gu_advance,
+    _gu_fire,
     _relabel_to,
     iter_unroll,
 )
@@ -100,8 +103,8 @@ def _cumsum_rows(x):
 def _phase_ladder_kernel(
     # scalar-prefetch / SMEM operands
     eps_ref,      # SMEM [NUM_PHASES] epsilon ladder
-    knobs_ref,    # SMEM [5]: max_iter, max_iter_total, global_every,
-                  #           bf_max, total supply
+    knobs_ref,    # SMEM [6]: max_iter, max_iter_total, global_every,
+                  #           bf_max, total supply, adaptive_bf
     # VMEM inputs
     C_ref,        # [E, M] scaled costs (INF_COST marks inadmissible)
     U_ref,        # [E, 1] scaled unscheduled costs
@@ -137,6 +140,7 @@ def _phase_ladder_kernel(
     global_every = knobs_ref[2]
     bf_max = knobs_ref[3]
     total = knobs_ref[4]
+    adaptive = knobs_ref[5]
 
     # Working state starts in the output refs.
     F_out[:] = F0_ref[:]
@@ -264,7 +268,7 @@ def _phase_ladder_kernel(
 
             def cond(st):
                 (_F, _Ffb, _Fmt, exc_e, exc_m, exc_t,
-                 _pe, _pm, _pt, it, _bf) = st
+                 _pe, _pm, _pt, it, _bf, _gu) = st
                 active = (
                     jnp.any(exc_e > 0) | jnp.any(exc_m > 0) | (exc_t > 0)
                 )
@@ -275,7 +279,9 @@ def _phase_ladder_kernel(
                 )
 
             def iterate(st):
-                F, Ffb, Fmt, exc_e, exc_m, exc_t, pe, pm, pt, it, bf = st
+                (F, Ffb, Fmt, exc_e, exc_m, exc_t, pe, pm, pt, it, bf,
+                 gu_state) = st
+                next_gu, gu_gap, last_exc = gu_state
                 # Convergence AND budget per sub-iteration (exact budget
                 # semantics despite the group-level while cond) — same
                 # gate as the lax path.
@@ -285,6 +291,11 @@ def _phase_ladder_kernel(
                     & (it < max_iter)
                     & (tot_it + it < max_iter_total)
                 )
+                # Pre-push ACTIVE excess: the adaptive global-update
+                # cadence's decay signal (transport._active_excess /
+                # _gu_advance — the SHARED schedule, so bit-parity with
+                # the lax path survives the adaptive flag).
+                tot_excess = _active_excess(exc_e, exc_m, exc_t)
 
                 rc_em = jnp.where(adm, C + pe - pm, _POS)
                 rc_fb = U + pe - pt            # [E,1]
@@ -395,16 +406,20 @@ def _phase_ladder_kernel(
                         F, Ffb, Fmt, pe, pm, pt, exc_e, exc_m, exc_t, eps
                     )
 
+                fired = _gu_fire(adaptive, it, next_gu, global_every) & active
                 pe_new, pm_new, pt_new, sweeps = lax.cond(
-                    (it % global_every == 0) & active,
-                    global_up, local_relabel, operand=None,
+                    fired, global_up, local_relabel, operand=None,
+                )
+                gu_state_new = _gu_advance(
+                    fired, tot_excess, it, next_gu, gu_gap, last_exc,
+                    global_every,
                 )
 
                 # Inactive sub-iterations freeze the state EXACTLY (the
                 # excess gates cover convergence but not budget
                 # exhaustion) — same select as the lax path.
                 (F_in, Ffb_in, Fmt_in, ee_in, em_in, et_in,
-                 pe_in, pm_in, pt_in, _it, _bf) = st
+                 pe_in, pm_in, pt_in, _it, _bf, _gu) = st
 
                 def sel(new, old):
                     return jnp.where(active, new, old)
@@ -416,6 +431,7 @@ def _phase_ladder_kernel(
                     sel(pe_new, pe_in), sel(pm_new, pm_in),
                     sel(pt_new, pt_in),
                     it + active.astype(jnp.int32), bf + sweeps,
+                    gu_state_new,
                 )
 
             unroll = iter_unroll()
@@ -426,8 +442,10 @@ def _phase_ladder_kernel(
                 return st
 
             init = (F, Ffb, Fmt, exc_e, exc_m, exc_t, pe, pm, pt,
-                    jnp.int32(0), jnp.int32(0))
-            (F, Ffb, Fmt, _ee, _em, _et, pe, pm, pt, iters, bf) = (
+                    jnp.int32(0), jnp.int32(0),
+                    (jnp.int32(0), jnp.asarray(global_every, jnp.int32),
+                     jnp.int32(0)))
+            (F, Ffb, Fmt, _ee, _em, _et, pe, pm, pt, iters, bf, _gu) = (
                 lax.while_loop(cond, body, init)
             )
             F_out[:] = F
@@ -460,7 +478,8 @@ def _phase_ladder_kernel(
 )
 def solve_device_fused(costs, supply, capacity, unsched_cost, arc_cap,
                        init_prices, init_flows, init_fb, eps_sched,
-                       max_iter_total, global_every, bf_max, *,
+                       max_iter_total, global_every, bf_max,
+                       adaptive_bf=0, *,
                        max_iter, scale, interpret=False):
     """Drop-in twin of transport._solve_device running the ladder as one
     Pallas kernel.  Same operand contract, same outputs
@@ -518,6 +537,7 @@ def solve_device_fused(costs, supply, capacity, unsched_cost, arc_cap,
         jnp.asarray(global_every, jnp.int32),
         jnp.asarray(bf_max, jnp.int32),
         total.astype(jnp.int32),
+        jnp.asarray(adaptive_bf, jnp.int32),
     ])
 
     out_shapes = (

@@ -76,6 +76,34 @@ PRUNE_MAX_WIDTH_DEN = 2
 PRICE_OUT_TOP_J = 8
 PRICE_OUT_MAX_ROUNDS = 3
 
+# Wave-shaped planes: very wide device planes with FEW EC rows (the 10k
+# fresh wave solves at [~100, 10240]) are device-bound — ~80% XLA compute
+# in the auction ladder (docs/PERF.md round 8) — so shrinking the device
+# width pays even though the host-side O(E*M) passes were never the
+# problem there.  The classic row gate (PRUNE_MIN_ROWS, sized for the
+# host-bound gang shape) would exclude them; wave-shaped planes qualify
+# through this secondary gate instead.  Every OTHER gate still applies —
+# in particular the capacity-slack gate, which correctly declines the
+# contended big wave band where a covering union would approach the full
+# width anyway.  POSEIDON_PRUNE_WAVE=0 restores the classic gate exactly.
+PRUNE_WAVE_MIN_ROWS = 16     # POSEIDON_PRUNE_WAVE_MIN_ROWS
+PRUNE_WAVE_MIN_COLS = 8192   # POSEIDON_PRUNE_WAVE_MIN_COLS
+
+
+def row_gate_ok(E: int, M: int, min_rows: int) -> bool:
+    """The shortlist planner's row gate, wave-shape aware.  Shared by
+    ``plan_shortlist`` and the planner's shortlist revival so the two
+    can never disagree on which planes prune."""
+    if E >= min_rows:
+        return True
+    if os.environ.get("POSEIDON_PRUNE_WAVE", "1") == "0":
+        return False
+    return (
+        E >= _env_int("POSEIDON_PRUNE_WAVE_MIN_ROWS", PRUNE_WAVE_MIN_ROWS)
+        and M >= _env_int("POSEIDON_PRUNE_WAVE_MIN_COLS",
+                          PRUNE_WAVE_MIN_COLS)
+    )
+
 
 @dataclass
 class ShortlistPlan:
@@ -115,7 +143,7 @@ def plan_shortlist(
     dense_factor = (PRUNE_DENSE_FACTOR if dense_factor is None
                     else dense_factor)
     slack = PRUNE_SLACK if slack is None else slack
-    if E < min_rows or M < min_cols:
+    if not row_gate_ok(E, M, min_rows) or M < min_cols:
         return None
     adm = costs < INF_COST
     if int(np.count_nonzero(adm)) * dense_factor < E * M:
@@ -538,6 +566,18 @@ class ExcludedColumnCert:
         self._ready = True
 
 
+def _carry_state(prices_full, flows_full, unsched, eps):
+    """Package a lifted full-plane state as a dense-path warm start:
+    (int32 prices, flows, unsched, exact eps the state satisfies
+    eps-complementary-slackness at).  Copies: the price-out loop keeps
+    mutating its working arrays after the snapshot."""
+    p = np.clip(
+        np.asarray(prices_full, dtype=np.int64),
+        np.iinfo(np.int32).min, np.iinfo(np.int32).max,
+    ).astype(np.int32)
+    return p, flows_full.copy(), np.asarray(unsched).copy(), int(eps)
+
+
 def scatter_flows(sel: np.ndarray, flows_r: np.ndarray, M: int) -> np.ndarray:
     """Reduced [E, W] flows -> full [E, M] (excluded columns zero)."""
     E = flows_r.shape[0]
@@ -662,6 +702,14 @@ def solve_pruned(
     reduced solve unconverged, price-out budget exhausted, or a
     certificate failure no column addition can answer); stats always
     reports what happened (``width``, ``rounds``, ``escalated``).
+
+    Escalations after at least one CERTIFIED reduced solve also carry
+    ``stats["carry"] = (prices_full, flows_full, unsched, eps)``: the
+    last lifted full-plane state and the exact epsilon it satisfies
+    eps-complementary-slackness at (the worst full-plane violation the
+    lift measured).  The dense fallback can warm-start the full ladder
+    there instead of re-paying the coarse pipeline from cold — the
+    price-out work the naive pruned-wave experiment double-paid.
     """
     costs = np.asarray(costs, dtype=np.int32)
     supply = np.asarray(supply, dtype=np.int32)
@@ -670,7 +718,7 @@ def solve_pruned(
     E, M = costs.shape
     stats = {"width": 0, "rounds": 0, "escalated": False,
              "declined": False, "iterations": 0, "bf_sweeps": 0,
-             "cert": "off", "sel": None}
+             "cert": "off", "sel": None, "carry": None}
     if plan is None:
         plan = plan_shortlist(costs, supply, capacity, arc_capacity,
                               **(plan_kw or {}))
@@ -765,6 +813,9 @@ def solve_pruned(
                 if status == "certified":
                     return accept(prices_full)
                 add_cols, worst = viol, int(worst_c)
+                stats["carry"] = _carry_state(
+                    prices_full, flows_full, sol_r.unsched, worst + 1
+                )
 
         if add_cols is None:
             # Classic full-plane pass (also the cache's refresh point:
@@ -779,6 +830,10 @@ def solve_pruned(
                 unsched_cost=unsched_cost, scale=scale,
                 arc_capacity=arc_capacity,
             )
+            if eps_full > 1:
+                stats["carry"] = _carry_state(
+                    prices_full, flows_full, sol_r.unsched, eps_full
+                )
             if eps_full <= 1:
                 if cert is not None:
                     min_e_base = min_e_eff

@@ -82,6 +82,9 @@ def solve_transport_sharded(
     global_update_every: int = 4,
     bf_max: int = 64,
     greedy_init: bool = True,
+    eps_exact: bool = False,  # accepted for wrapper parity; the sharded
+    # path runs no pre-dispatch host certificate, so there is nothing
+    # to skip (the fallback below forwards it to the single-chip path).
 ) -> TransportSolution:
     """Drop-in mesh-sharded variant of ``transport.solve_transport``.
 
@@ -107,7 +110,7 @@ def solve_transport_sharded(
             max_iter_total=max_iter_total, scale=scale,
             max_cost_hint=max_cost_hint,
             global_update_every=global_update_every, bf_max=bf_max,
-            greedy_init=greedy_init,
+            greedy_init=greedy_init, eps_exact=eps_exact,
         )
 
     # Pad machines to a quarter-octave bucket rounded up to a mesh
@@ -157,7 +160,7 @@ def solve_transport_sharded(
         prices_p[e_pad : e_pad + M] = init_prices[E : E + M]
         prices_p[e_pad + m_pad] = init_prices[E + M]
 
-    scale, eps_sched = _host_validate(
+    scale, eps_sched, eps0_cold = _host_validate(
         costs_p, supply_p, capacity_p, unsched_p, scale, eps_start,
         max_cost_hint,
     )
@@ -185,6 +188,10 @@ def solve_transport_sharded(
         put(jnp.int32(max_iter_total), repl),
         put(jnp.int32(global_update_every), repl),
         put(jnp.int32(bf_max), repl),
+        # Same call-time adaptive-cadence policy as the single-chip
+        # wrapper (traced operand) — sharded and single-chip solves stay
+        # bit-identical under either setting.
+        put(jnp.int32(transport.adaptive_bf_flag()), repl),
         max_iter=max_iter_per_phase, scale=int(scale),
     )
 
@@ -195,10 +202,14 @@ def solve_transport_sharded(
         [prices_full[:E], prices_full[e_pad : e_pad + M],
          prices_full[e_pad + m_pad :]]
     )
-    return _host_finalize(
+    sol = _host_finalize(
         flows, unsched, prices_out, iters,
         costs=costs, supply=supply, capacity=capacity,
         unsched_cost=unsched_cost, scale=scale, clean=clean,
         arc_capacity=arc_capacity, bf_sweeps=int(bf),
         phase_iters=tuple(int(x) for x in np.asarray(phase_iters)),
     )
+    from poseidon_tpu.ops.transport import ladder_entry_phase
+
+    sol.entry_phase = ladder_entry_phase(eps0_cold, int(eps_sched[0]))
+    return sol

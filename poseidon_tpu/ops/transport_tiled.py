@@ -42,7 +42,10 @@ from poseidon_tpu.ops.transport import (
     _NEG,
     _POS,
     INF_COST,
+    _active_excess,
     _global_update,
+    _gu_advance,
+    _gu_fire,
     _relabel_to,
 )
 from poseidon_tpu.ops.transport_fused import _cumsum_cols, _cumsum_rows
@@ -299,7 +302,7 @@ def _tiled_iteration(C, Uem, U2, sup2, cap2, F, Ffb2, Fmt2, pe2, pm2, pt,
 
 def _pr_phase_tiled(carry, eps, *, C, Uem, U2, sup2, cap2, total,
                     max_iter, max_iter_total, global_every, bf_max,
-                    interpret):
+                    adaptive, interpret):
     """transport._pr_phase with the iteration body as one kernel launch.
 
     Operands are kernel-shaped (see _tiled_iteration); the refine step
@@ -333,20 +336,25 @@ def _pr_phase_tiled(carry, eps, *, C, Uem, U2, sup2, cap2, total,
 
     def cond(st):
         (_F, _Ffb, _Fmt, exc_e, exc_m, exc_t, _pe, _pm, _pt, it,
-         _bf) = st
+         _bf, _gu) = st
         active = jnp.any(exc_e > 0) | jnp.any(exc_m > 0) | (exc_t > 0)
         return (
             (it < max_iter) & (total_iters + it < max_iter_total) & active
         )
 
     def body(st):
-        F, Ffb, Fmt, exc_e, exc_m, exc_t, pe, pm, pt, it, bf = st
+        F, Ffb, Fmt, exc_e, exc_m, exc_t, pe, pm, pt, it, bf, gu_state = st
+        next_gu, gu_gap, last_exc = gu_state
         active = (
             (jnp.any(exc_e > 0) | jnp.any(exc_m > 0) | (exc_t > 0))
             & (it < max_iter)
             & (total_iters + it < max_iter_total)
         )
-        is_global = (it % global_every == 0) & active
+        # Pre-push ACTIVE excess for the adaptive cadence (the SHARED
+        # transport._active_excess/_gu_fire/_gu_advance helpers —
+        # bit-parity with the lax path holds under the adaptive flag).
+        tot_excess = _active_excess(exc_e, exc_m, exc_t)
+        is_global = _gu_fire(adaptive, it, next_gu, global_every) & active
 
         (F2, Ffb2, Fmt2, pe2, pm2, pt2, exc_e2, exc_m2,
          exc_t2) = _tiled_iteration(
@@ -373,6 +381,10 @@ def _pr_phase_tiled(carry, eps, *, C, Uem, U2, sup2, cap2, total,
         pe3, pm3, pt3, sweeps = lax.cond(
             is_global, global_up, keep, operand=None
         )
+        gu_state_new = _gu_advance(
+            is_global, tot_excess, it, next_gu, gu_gap, last_exc,
+            global_every,
+        )
 
         def sel(new, old):
             return jnp.where(active, new, old)
@@ -381,14 +393,15 @@ def _pr_phase_tiled(carry, eps, *, C, Uem, U2, sup2, cap2, total,
             sel(F2, F), sel(Ffb2, Ffb), sel(Fmt2, Fmt),
             sel(exc_e2, exc_e), sel(exc_m2, exc_m), sel(exc_t2, exc_t),
             sel(pe3, pe), sel(pm3, pm), sel(pt3, pt),
-            it + active.astype(jnp.int32), bf + sweeps,
+            it + active.astype(jnp.int32), bf + sweeps, gu_state_new,
         )
 
     init = (F, Ffb, Fmt, exc_e, exc_m, exc_t, pe, pm, pt,
-            jnp.int32(0), jnp.int32(0))
-    (F, Ffb, Fmt, _ee, _em, _et, pe, pm, pt, iters, bf) = lax.while_loop(
-        cond, body, init
-    )
+            jnp.int32(0), jnp.int32(0),
+            (jnp.int32(0), jnp.asarray(global_every, jnp.int32),
+             jnp.int32(0)))
+    (F, Ffb, Fmt, _ee, _em, _et, pe, pm, pt, iters, bf,
+     _gu) = lax.while_loop(cond, body, init)
     return (
         F, Ffb, Fmt, pe, pm, pt, total_iters + iters, total_bf + bf
     ), iters
@@ -399,7 +412,8 @@ def _pr_phase_tiled(carry, eps, *, C, Uem, U2, sup2, cap2, total,
 )
 def solve_device_tiled(costs, supply, capacity, unsched_cost, arc_cap,
                        init_prices, init_flows, init_fb, eps_sched,
-                       max_iter_total, global_every, bf_max, *,
+                       max_iter_total, global_every, bf_max,
+                       adaptive_bf=0, *,
                        max_iter, scale, interpret=False):
     """Drop-in twin of transport._solve_device with the iteration body as
     one tiled kernel launch.  Same operand contract, same outputs,
@@ -447,7 +461,8 @@ def solve_device_tiled(costs, supply, capacity, unsched_cost, arc_cap,
         _pr_phase_tiled, C=C, Uem=Uem, U2=U[:, None],
         sup2=supply_k[:, None], cap2=cap_k[None, :], total=total,
         max_iter=max_iter, max_iter_total=max_iter_total,
-        global_every=global_every, bf_max=bf_max, interpret=interpret,
+        global_every=global_every, bf_max=bf_max, adaptive=adaptive_bf,
+        interpret=interpret,
     )
     carry0 = (F0, Ffb0[:, None], Fmt0[None, :], pe[:, None], pm[None, :],
               pt.astype(jnp.int32), jnp.int32(0), jnp.int32(0))

@@ -17,6 +17,7 @@ import logging
 import os
 import signal
 import threading
+import time
 from concurrent import futures
 from typing import Optional
 
@@ -109,16 +110,45 @@ class FirmamentServicer:
         calls it eagerly instead — a lazy precompile keeps running in
         the first round's handler thread after the client's deadline
         expires, and its compile-completion events then straggle into
-        later rounds' ledger windows."""
+        later rounds' ledger windows.
+
+        ``POSEIDON_COMPILE_CACHE_DIR`` points the run at a persistent
+        on-disk XLA compilation cache BEFORE the ladder compiles: a
+        restarting service then warms its whole shape ladder from disk
+        in seconds instead of re-paying the compile storm (the 451 s
+        cold-start measured live at 10k machines, BENCH_r05
+        last_live_tpu — remote compiles are cached too).  The realized
+        precompile wall seconds and shape count ride /metrics as gauges
+        (``poseidon_precompile_*``), so a restart that silently missed
+        the cache is visible as a wall-time spike, not a mystery."""
         with self._schedule_lock:
             if not self.config.precompile or self._precompiled:
                 return 0
             self._precompiled = True
+            cache_dir = os.environ.get("POSEIDON_COMPILE_CACHE_DIR")
+            if cache_dir:
+                from poseidon_tpu.utils.envutil import (
+                    enable_compilation_cache,
+                )
+
+                enable_compilation_cache(cache_dir)
+            t0 = time.perf_counter()
             n = self.planner.precompile(
                 max_ecs=self.config.max_ecs,
                 max_machines=self.config.max_machines,
             )
-            log.info("precompiled %d solver shapes", n)
+            wall = time.perf_counter() - t0
+            obs_metrics.default_registry().gauge(
+                "poseidon_precompile_seconds",
+                "Wall seconds the startup solver-ladder precompile took "
+                "(persistent-cache hits make this seconds, not minutes)",
+            ).set(wall)
+            obs_metrics.default_registry().gauge(
+                "poseidon_precompile_shapes",
+                "Solver shapes compiled/warmed by the startup precompile",
+            ).set(float(n))
+            log.info("precompiled %d solver shapes in %.1fs%s", n, wall,
+                     f" (cache: {cache_dir})" if cache_dir else "")
             return n
 
     def Schedule(self, request, context):

@@ -1,0 +1,380 @@
+"""Adaptive epsilon-ladder entry + adaptive global-update cadence +
+pinned-scale coarse: the round-9 device-wave paths, parity-pinned.
+
+The contract (ISSUE 8 acceptance): with the escape hatches OFF
+(``POSEIDON_ADAPTIVE_LADDER=0``, ``POSEIDON_ADAPTIVE_BF=0``,
+``POSEIDON_COARSE_PINNED=0``) the solver arithmetic is bit-identical to
+the pre-round-9 code; with them ON every accepted solution still carries
+the same certificate (gap_bound == 0 on solvable instances) and the
+objective is IDENTICAL to the fixed-ladder path — entry-phase selection
+and update cadence may change the iterate path, never the optimum.
+"""
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.ops import transport
+from poseidon_tpu.ops.transport import (
+    INF_COST,
+    NUM_PHASES,
+    derive_scale,
+    padded_shape,
+    solve_transport,
+)
+
+
+def _instance(E, M, seed, contended=False, inf_frac=0.1):
+    rng = np.random.default_rng(seed)
+    costs = rng.integers(0, 1000, size=(E, M)).astype(np.int32)
+    costs[rng.random((E, M)) < inf_frac] = INF_COST
+    supply = rng.integers(1, 9, size=E).astype(np.int32)
+    cap = (
+        np.full(M, max(1, int(supply.sum()) // (2 * M) + 1), np.int32)
+        if contended
+        else rng.integers(1, 12, size=M).astype(np.int32)
+    )
+    unsched = rng.integers(1000, 2000, size=E).astype(np.int32)
+    arc = rng.integers(1, 6, size=(E, M)).astype(np.int32)
+    return costs, supply, cap, unsched, arc
+
+
+def _drift(costs, rng, mag=40):
+    d = rng.integers(-mag, mag + 1, size=costs.shape).astype(np.int32)
+    out = np.where(costs < INF_COST, np.clip(costs + d, 0, 4000), costs)
+    return out.astype(np.int32)
+
+
+def _off(monkeypatch):
+    monkeypatch.setenv("POSEIDON_ADAPTIVE_LADDER", "0")
+    monkeypatch.setenv("POSEIDON_ADAPTIVE_BF", "0")
+
+
+def _on(monkeypatch):
+    monkeypatch.setenv("POSEIDON_ADAPTIVE_LADDER", "1")
+    monkeypatch.setenv("POSEIDON_ADAPTIVE_BF", "1")
+
+
+def _certified_equal(a, b):
+    """Both certified exactly optimal, identical objectives: the adaptive
+    paths may walk a different iterate sequence but never a different
+    optimum (placements equal or cost-equal)."""
+    assert a.gap_bound == 0.0, a.gap_bound
+    assert b.gap_bound == 0.0, b.gap_bound
+    assert a.objective == b.objective
+
+
+# ------------------------------------------------------- warm-frame entry
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_adaptive_warm_entry_parity(monkeypatch, seed):
+    """Warm drift re-solves: the adaptive ladder enters at the start's
+    CERTIFIED eps (host-checked from the duals) instead of the drift
+    bound — same certificate, same objective as the fixed entry."""
+    rng = np.random.default_rng(100 + seed)
+    costs, supply, cap, unsched, arc = _instance(16, 96, seed)
+    _off(monkeypatch)
+    first = solve_transport(costs, supply, cap, unsched, arc_capacity=arc)
+    assert first.gap_bound == 0.0
+    costs2 = _drift(costs, rng)
+    # Drift-bound epsilon, exactly as the planner's incremental path
+    # derives it (drift * scale + 1).
+    e_pad, m_pad = padded_shape(*costs.shape)
+    scale, _ = derive_scale(costs2, unsched, None, e_pad, m_pad)
+    eps_drift = 40 * scale + 1
+    kw = dict(
+        arc_capacity=arc, init_flows=first.flows,
+        init_unsched=first.unsched, eps_start=eps_drift,
+    )
+    _off(monkeypatch)
+    fixed = solve_transport(costs2, supply, cap, unsched, first.prices, **kw)
+    _on(monkeypatch)
+    adapt = solve_transport(costs2, supply, cap, unsched, first.prices, **kw)
+    _certified_equal(fixed, adapt)
+    # The adaptive entry can only lower (or keep) the entry epsilon,
+    # never raise it — iteration counts may wiggle either way (a lower
+    # entry walks a different, equally-certified path).
+    assert adapt.entry_phase >= fixed.entry_phase
+
+
+@pytest.mark.parametrize("contended", [False, True])
+def test_adaptive_cold_parity(monkeypatch, contended):
+    """Cold solves (greedy/coarse-free small instances): adaptive paths
+    on vs off certify the identical optimum."""
+    costs, supply, cap, unsched, arc = _instance(
+        20, 128, 7, contended=contended
+    )
+    _off(monkeypatch)
+    fixed = solve_transport(costs, supply, cap, unsched, arc_capacity=arc)
+    _on(monkeypatch)
+    adapt = solve_transport(costs, supply, cap, unsched, arc_capacity=arc)
+    _certified_equal(fixed, adapt)
+
+
+def test_adaptive_off_is_bit_identical(monkeypatch):
+    """The escape hatch: with both knobs off, repeated solves of the same
+    instance are bit-for-bit reproducible (the hatches select the
+    pre-round-9 arithmetic exactly — the fused-kernel parity suite pins
+    the same property across implementations)."""
+    costs, supply, cap, unsched, arc = _instance(16, 64, 3, contended=True)
+    _off(monkeypatch)
+    a = solve_transport(costs, supply, cap, unsched, arc_capacity=arc)
+    b = solve_transport(costs, supply, cap, unsched, arc_capacity=arc)
+    np.testing.assert_array_equal(a.flows, b.flows)
+    np.testing.assert_array_equal(a.prices, b.prices)
+    assert a.iterations == b.iterations
+    assert a.bf_sweeps == b.bf_sweeps
+
+
+def test_adaptive_bf_changes_schedule_not_optimum(monkeypatch):
+    """The adaptive cadence is live (wiring test): on a contended
+    instance with a long ladder it must produce a valid certified solve;
+    sweeps may differ from the fixed cadence, the optimum must not."""
+    costs, supply, cap, unsched, arc = _instance(24, 96, 11, contended=True)
+    _off(monkeypatch)
+    fixed = solve_transport(costs, supply, cap, unsched, arc_capacity=arc)
+    monkeypatch.setenv("POSEIDON_ADAPTIVE_BF", "1")
+    adapt = solve_transport(costs, supply, cap, unsched, arc_capacity=arc)
+    _certified_equal(fixed, adapt)
+
+
+# ----------------------------------------------- fused/tiled kernel parity
+
+
+@pytest.mark.parametrize("impl_env", ["POSEIDON_FUSED", "POSEIDON_TILED"])
+def test_kernel_parity_holds_under_adaptive_bf(monkeypatch, impl_env):
+    """The Pallas twins implement the SAME adaptive schedule (shared
+    scalar helpers): bit-parity with the lax path must hold with the
+    adaptive cadence enabled, exactly as the fixed-cadence suites pin."""
+    if impl_env == "POSEIDON_TILED":
+        import poseidon_tpu.ops.transport_fused as TF
+        import poseidon_tpu.ops.transport_tiled as TT
+
+        # Route through the tiled gate: needs fits_tile true and
+        # fits_vmem false at this shape.
+        monkeypatch.setattr(TF, "fits_vmem", lambda e, m: False)
+        monkeypatch.setattr(TT, "fits_tile", lambda e: True)
+    costs, supply, cap, unsched, arc = _instance(16, 64, 5, contended=True)
+    monkeypatch.setenv("POSEIDON_ADAPTIVE_BF", "1")
+    monkeypatch.setenv(impl_env, "0")
+    lax_sol = solve_transport(costs, supply, cap, unsched, arc_capacity=arc)
+    monkeypatch.setenv(impl_env, "1")
+    pallas_sol = solve_transport(
+        costs, supply, cap, unsched, arc_capacity=arc
+    )
+    np.testing.assert_array_equal(lax_sol.flows, pallas_sol.flows)
+    np.testing.assert_array_equal(lax_sol.prices, pallas_sol.prices)
+    assert lax_sol.iterations == pallas_sol.iterations
+    assert lax_sol.bf_sweeps == pallas_sol.bf_sweeps
+    assert lax_sol.phase_iters == pallas_sol.phase_iters
+
+
+# ------------------------------------------------------ pinned-scale coarse
+
+
+def _coarse_instance(seed):
+    """Big enough for the coarse gates (M >= COARSE_MIN_MACHINES,
+    supply >= 4K) yet cheap on CPU."""
+    rng = np.random.default_rng(seed)
+    E, M = 12, 1024
+    # Load-shaped columns (distinct column means) so grouping has
+    # structure and the greedy start does NOT certify (the coarse solve
+    # actually runs).
+    base = rng.integers(0, 800, size=M)
+    costs = (base[None, :] + rng.integers(0, 64, size=(E, M))).astype(
+        np.int32
+    )
+    supply = np.full(E, 96, dtype=np.int32)
+    cap = np.full(M, 6, dtype=np.int32)
+    unsched = np.full(E, 2000, dtype=np.int32)
+    return costs, supply, cap, unsched
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_coarse_warm_start_pinned_scale_bit_identical(monkeypatch, seed):
+    """Where the pinned scale EQUALS the derived one (the full-plane
+    case), the pinned-scale coarse path must be bit-identical to the
+    unpinned path — the satellite-4 pin for the reduced-plane road."""
+    from poseidon_tpu.ops.transport import coarse_warm_start
+
+    costs, supply, cap, unsched = _coarse_instance(seed)
+    e_pad, m_pad = padded_shape(*costs.shape)
+    scale, _ = derive_scale(costs, unsched, None, e_pad, m_pad)
+
+    def solve(c, s, k, u, **kw):
+        return solve_transport(c, s, k, u, **kw)
+
+    from poseidon_tpu.ops.transport import coarse_precheck
+
+    pre_unpinned = coarse_precheck(
+        costs, supply, cap, None, unsched, None
+    )
+    pre_pinned = coarse_precheck(
+        costs, supply, cap, None, unsched, None, scale=scale
+    )
+    assert pre_unpinned is not None and pre_pinned is not None
+    assert pre_pinned["scale"] == pre_unpinned["scale"] == scale
+    a = coarse_warm_start(
+        costs, supply, cap, unsched, None, solve, pre=pre_unpinned
+    )
+    b = coarse_warm_start(
+        costs, supply, cap, unsched, None, solve, pre=pre_pinned
+    )
+    assert (a is None) == (b is None)
+    if a is not None:
+        pa, fa, ua, ea = a
+        pb, fb, ub, eb = b
+        np.testing.assert_array_equal(pa, pb)
+        np.testing.assert_array_equal(fa, fb)
+        np.testing.assert_array_equal(ua, ub)
+        assert ea == eb
+
+
+def test_solve_plane_pinned_coarse_runs_and_certifies(monkeypatch):
+    """The planner's pinned-scale plane solve (the pruned path's shape):
+    _solve_plane with an explicit scale must still run the coarse warm
+    start (POSEIDON_COARSE_PINNED default-on) and certify the same
+    objective as the dense unpinned solve."""
+    from poseidon_tpu.ops.transport import _certified_eps
+
+    costs, supply, cap, unsched = _coarse_instance(5)
+    e_pad, m_pad = padded_shape(*costs.shape)
+    scale, _ = derive_scale(costs, unsched, None, e_pad, m_pad)
+    _off(monkeypatch)
+    ref = solve_transport(costs, supply, cap, unsched)
+    _on(monkeypatch)
+    pinned = solve_transport(costs, supply, cap, unsched, scale=scale)
+    _certified_equal(ref, pinned)
+    eps = _certified_eps(
+        pinned.flows, pinned.unsched, pinned.prices, costs=costs,
+        supply=supply, capacity=cap, unsched_cost=unsched, scale=scale,
+    )
+    assert eps <= 1
+
+
+# ----------------------------------------------- randomized mixed regimes
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_randomized_regimes_parity(monkeypatch, seed):
+    """Fuzzed cold/warm/repair starts: adaptive on vs off always lands
+    on a certified-equal optimum.  Repair shape: warm frame stranded on
+    freshly forbidden rows (the gang-repair start)."""
+    rng = np.random.default_rng(7000 + seed)
+    E, M = int(rng.integers(8, 28)), int(rng.integers(48, 160))
+    contended = bool(rng.integers(0, 2))
+    costs, supply, cap, unsched, arc = _instance(
+        E, M, seed + 50, contended=contended
+    )
+    _off(monkeypatch)
+    base = solve_transport(costs, supply, cap, unsched, arc_capacity=arc)
+    # Repair-shaped drift: forbid a loaded row outright + drift the rest.
+    costs2 = _drift(costs, rng)
+    loaded_rows = np.nonzero(base.flows.sum(axis=1) > 0)[0]
+    if loaded_rows.size:
+        costs2[loaded_rows[int(rng.integers(0, loaded_rows.size))]] = (
+            INF_COST
+        )
+    kw = dict(
+        arc_capacity=arc, init_flows=base.flows,
+        init_unsched=base.unsched, eps_start=1,
+    )
+    _off(monkeypatch)
+    fixed = solve_transport(costs2, supply, cap, unsched, base.prices, **kw)
+    _on(monkeypatch)
+    adapt = solve_transport(costs2, supply, cap, unsched, base.prices, **kw)
+    _certified_equal(fixed, adapt)
+
+
+# ------------------------------------------------------- entry telemetry
+
+
+def test_entry_phase_telemetry(monkeypatch):
+    """TransportSolution.entry_phase: 0 on cold full-ladder solves,
+    positive when a certified start entered the ladder below the cold
+    eps0 (the round-metrics/bench 'ladder entry phase' series)."""
+    costs, supply, cap, unsched, arc = _instance(16, 96, 9, contended=True)
+    _on(monkeypatch)
+    cold = solve_transport(costs, supply, cap, unsched, arc_capacity=arc)
+    assert cold.entry_phase == 0
+    drifted = _drift(np.asarray(costs), np.random.default_rng(1), mag=2)
+    warm = solve_transport(
+        drifted, supply, cap, unsched, cold.prices,
+        arc_capacity=arc, init_flows=cold.flows,
+        init_unsched=cold.unsched, eps_start=3,
+    )
+    assert warm.gap_bound == 0.0
+    assert 0 < warm.entry_phase <= NUM_PHASES
+
+
+# ------------------------------------------------- escalation warm-carry
+
+
+def test_escalation_carry_is_sound_warm_start():
+    """A pruned-path escalation's ``stats['carry']`` (the last lifted
+    full-plane state + its exact eps) must be a certified-sound warm
+    start for the dense fallback: solving from it lands on the dense
+    optimum with an exact certificate — the de-double-pay road for
+    price-out re-solves."""
+    from poseidon_tpu.ops import transport_pruned as tp
+
+    # The engineered price-out shape from test_transport_pruned: the
+    # shortlist's cheapest columns are arc-blocked for every row, so the
+    # reduced optimum strands supply on the fallback while cheaper open
+    # columns sit outside the union.
+    E, M = 4, 128
+    costs = np.broadcast_to(np.arange(M, dtype=np.int32), (E, M)).copy()
+    supply = np.full(E, 8, dtype=np.int32)
+    capacity = np.full(M, 2, dtype=np.int32)
+    unsched = np.full(E, 500, dtype=np.int32)
+    arc = np.full((E, M), 8, dtype=np.int32)
+    arc[:, :64] = 0
+    scale, _ = derive_scale(costs, unsched, None, *padded_shape(E, M))
+
+    def solve_on(sel, warm):
+        p = f = u = eps = None
+        if warm is not None and warm[0] is not None:
+            p, f, u, eps = warm
+        sol = solve_transport(
+            costs[:, sel], supply, capacity[sel], unsched, p,
+            arc_capacity=arc[:, sel], init_flows=f, init_unsched=u,
+            eps_start=eps, scale=scale,
+        )
+        return sol, costs[:, sel]
+
+    sol, eff, stats = tp.solve_pruned(
+        costs, supply, capacity, unsched, arc_capacity=arc, scale=scale,
+        solve_on=solve_on, plan_kw=dict(min_rows=2, min_cols=16),
+        max_rounds=0,
+    )
+    assert sol is None and stats["escalated"]
+    carry = stats["carry"]
+    assert carry is not None
+    p, f, u, eps = carry
+    assert p.dtype == np.int32 and eps > 1
+    dense = solve_transport(costs, supply, capacity, unsched,
+                            arc_capacity=arc)
+    warmed = solve_transport(
+        costs, supply, capacity, unsched, p, arc_capacity=arc,
+        init_flows=f, init_unsched=u, eps_start=eps, eps_exact=True,
+    )
+    assert warmed.gap_bound == 0.0 == dense.gap_bound
+    assert warmed.objective == dense.objective
+
+
+def test_wave_shaped_row_gate():
+    """The wave-shaped secondary row gate: few-row/very-wide planes
+    qualify, POSEIDON_PRUNE_WAVE=0 restores the classic gate exactly."""
+    import os
+
+    from poseidon_tpu.ops import transport_pruned as tp
+
+    assert tp.row_gate_ok(400, 4096, 192)          # classic
+    assert not tp.row_gate_ok(100, 4096, 192)      # too narrow for wave
+    assert tp.row_gate_ok(100, 10240, 192)         # the 10k wave shape
+    assert not tp.row_gate_ok(8, 10240, 192)       # too few rows even so
+    os.environ["POSEIDON_PRUNE_WAVE"] = "0"
+    try:
+        assert not tp.row_gate_ok(100, 10240, 192)
+    finally:
+        os.environ.pop("POSEIDON_PRUNE_WAVE")

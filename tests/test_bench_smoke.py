@@ -97,3 +97,50 @@ def test_churn_rounds_serve_incrementally(monkeypatch):
     assert delta_rounds >= 2, (
         f"only {delta_rounds}/5 churn rounds served incrementally"
     )
+
+
+def test_wave_rung_smoke_warm_rounds_compile_free():
+    """Tiny wave rung (the satellite the wave path never had): a cold
+    wave round compiles, then — after the production-shaped precompile —
+    a FRESH-population warm wave and a churn round must both run under
+    ``CompileLedger(budget=0)``.  A ladder-schedule or adaptive-cadence
+    value leaking into a compile key would retrace here and fail with
+    the compiled program names."""
+    import numpy as np
+
+    import bench
+    from poseidon_tpu.check.ledger import CompileLedger
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+
+    state = bench.build_cluster(200, 2000, 16, seed=0)
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+    _, m_cold = planner.schedule_round()  # cold round: compiles expected
+    assert m_cold.placed > 0
+    planner.precompile(max_ecs=16)
+
+    # Fresh wave: drain + resubmit NEW random shapes (new EC ids, new
+    # costs — the bench rung's wave semantics, scaled down).
+    for uid in list(state.tasks.keys()):
+        state.task_removed(uid)
+    bench.submit_population(state, 2000, 16, seed=1)
+    with CompileLedger(budget=0, label="warm wave round"):
+        _, m_wave = planner.schedule_round()
+    assert m_wave.placed > 0
+    assert m_wave.converged
+    assert m_wave.gap_bound == 0.0
+    # The device series the rung artifact now gates ride RoundMetrics:
+    # a solved round must carry a real per-phase split, and the entry
+    # phase must be in the ladder's range (the field is NUM_PHASES for
+    # no-solve rounds — this round solved).
+    from poseidon_tpu.ops.transport import NUM_PHASES
+
+    assert 0 <= m_wave.ladder_entry_phase <= NUM_PHASES
+    assert len(m_wave.solve_phase_iters) == NUM_PHASES
+    assert sum(m_wave.solve_phase_iters) >= 0
+
+    rng = np.random.default_rng(5)
+    bench.churn_step(state, rng)
+    with CompileLedger(budget=0, label="warm churn round"):
+        _, m_churn = planner.schedule_round()
+    assert m_churn.converged

@@ -119,13 +119,15 @@ def test_determinism_scope_covers_chaos():
 def test_retrace_guard_violations():
     found = _findings("retrace-guard", "retrace_guard_violations.py")
     msgs = [f.message for f in found]
-    assert len(found) == 11
+    assert len(found) == 12
     assert sum("fresh compile cache" in m for m in msgs) == 6
     assert sum("module-level loop" in m for m in msgs) == 2
     assert sum("drive" in m for m in msgs) == 1  # class-method hazard
     assert sum("retraces per value" in m for m in msgs) == 1
     assert sum("str constant at traced position" in m for m in msgs) == 1
-    assert sum("bool constant at traced position" in m for m in msgs) == 1
+    # Two bool-at-traced cases: the dropped-static-entry shape and the
+    # ladder-schedule-as-Python-value shape (adaptive-cadence flag).
+    assert sum("bool constant at traced position" in m for m in msgs) == 2
     assert sum("pad through bucket_size" in m for m in msgs) == 1
     assert sum("weak f32/f64" in m for m in msgs) == 1
     # The suppressed float literal did not count.

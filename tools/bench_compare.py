@@ -40,6 +40,28 @@ _FEATURE_STAGES = (
     "solve_s", "view_build_s",
 )
 
+# Per-round DEVICE-WORK series (run_rung artifacts): summed across
+# rounds and gated as counts — machine-independent, so they catch a
+# device-work regression wall time hides behind host overlap (and the
+# reverse).  Tolerances are looser than the timing band (fresh-wave
+# iteration counts vary a few percent run to run through tie-breaks)
+# with absolute floors sized to each unit.
+_COUNT_SERIES = (
+    # (artifact key, tolerance, absolute floor)
+    ("wave_solve_iters", 0.5, 64),
+    ("wave_bf_sweeps", 0.5, 256),
+    ("wave_device_calls", 0.5, 2),
+    # Churn series are BIMODAL: a round whose warm start passes the
+    # exact host certificate costs 0 iterations, a miss is a genuine
+    # ~500-1000-iteration redistribution — and which equally-optimal
+    # equilibrium the preceding wave landed on decides the flip.  The
+    # band is sized so ONE extra flip over the committed baseline
+    # (which already carries one, sum ~1100) passes and two fail —
+    # a systemic loss of the zero-dispatch steady state stays caught.
+    ("churn_solve_iters", 1.2, 512),
+    ("churn_device_calls", 1.2, 3),
+)
+
 
 def load_artifact(path: str) -> Optional[dict]:
     """Parse a bench artifact: a plain JSON object, a ``.jsonl`` stream
@@ -118,6 +140,21 @@ def collect_timings(art: dict) -> Dict[str, float]:
     return out
 
 
+def collect_counts(art: dict) -> Dict[str, Tuple[float, float, float]]:
+    """Device-work count series -> {name: (total, tolerance, floor)}.
+    Series are per-round lists in the rung artifact; the gate compares
+    their SUMS (per-round jitter is tie-break noise, the total is the
+    device work the config paid)."""
+    out: Dict[str, Tuple[float, float, float]] = {}
+    for key, tol, floor in _COUNT_SERIES:
+        val = art.get(key)
+        if isinstance(val, list) and val and all(
+            isinstance(v, (int, float)) for v in val
+        ):
+            out[f"device.{key}"] = (float(sum(val)), tol, float(floor))
+    return out
+
+
 def compare(
     baseline: dict,
     current: dict,
@@ -158,9 +195,31 @@ def compare(
             "name": name, "baseline_s": b, "current_s": c,
             "ratio": round(ratio, 3), "verdict": verdict,
         })
+    # Device-work count series: per-series tolerance/floor (the units
+    # differ — iterations vs dispatches).  Same skip semantics as the
+    # timing rows: a series present on one side only is reported.
+    base_c, cur_c = collect_counts(baseline), collect_counts(current)
+    skipped.extend(sorted(set(base_c) ^ set(cur_c)))
+    for name in sorted(set(base_c) & set(cur_c)):
+        b, tol, floor = base_c[name]
+        c = cur_c[name][0]
+        ratio = (c / b) if b > 0 else float("inf")
+        verdict = "ok"
+        if c > b * (1.0 + tol) and (c - b) > floor:
+            verdict = "regression"
+            regressions.append(name)
+        elif c < b * max(1.0 - tol, 0.5) and (b - c) > floor:
+            # Improvement band capped at halving: with tol >= 1 the
+            # symmetric band would be negative and genuine wins (e.g.
+            # every churn flip eliminated) would read as plain "ok".
+            verdict = "improved"
+        rows.append({
+            "name": name, "baseline_s": b, "current_s": c,
+            "ratio": round(ratio, 3), "verdict": verdict,
+        })
     return {
         "comparable": True, "reason": None, "rows": rows,
-        "skipped": skipped, "regressions": regressions,
+        "skipped": sorted(skipped), "regressions": regressions,
     }
 
 
