@@ -68,26 +68,40 @@ import time
 
 import numpy as np
 
+from poseidon_tpu.utils.hatches import hatch_flag, hatch_float, hatch_int
+
 # North-star config FIRST: any budget squeeze (wedged tunnel, slow
 # backend, outer deadline) must cost the scaling-table rungs, never the
 # scored 10k/100k number (round-4 review: the ascending ladder made the
 # north-star rung the first casualty of every timeout).
 NORTH_STAR = (10_000, 100_000)
 LADDER = [NORTH_STAR, (1_000, 10_000), (2_000, 20_000), (4_000, 40_000)]
-RUNG_TIMEOUT_S = int(os.environ.get("POSEIDON_BENCH_RUNG_TIMEOUT", "1800"))
 PARITY_TIMEOUT_S = 600
-# BASELINE configs 2-4 (selectors/affinity/gang) run at the north-star
-# scale (10k machines, ~45 s warm + compile headroom); cluster scale
-# needs more than the parity budget.
-FEATURES_TIMEOUT_S = int(
-    os.environ.get("POSEIDON_BENCH_FEATURES_TIMEOUT", "1200")
-)
-# Grace between SIGTERM and SIGKILL for a timed-out child: the child's
-# SIGTERM handler (install_graceful_term) exits after the in-flight
-# device op returns, so the grace must cover one worst-case device
-# program.  SIGKILL is the very last resort — killing a chip-holding
-# process mid-op wedges the tunnel for everyone.
-TERM_GRACE_S = int(os.environ.get("POSEIDON_BENCH_TERM_GRACE", "300"))
+
+
+def rung_timeout_s() -> int:
+    """Per-rung child budget — read at call time (the hatch registry's
+    import-time-read discipline: a wrapper exporting the knob after
+    this module loads must still be honored)."""
+    return hatch_int("POSEIDON_BENCH_RUNG_TIMEOUT")
+
+
+def features_timeout_s() -> int:
+    """BASELINE configs 2-4 (selectors/affinity/gang) run at the
+    north-star scale (10k machines, ~45 s warm + compile headroom);
+    cluster scale needs more than the parity budget."""
+    return hatch_int("POSEIDON_BENCH_FEATURES_TIMEOUT")
+
+
+def term_grace_s() -> int:
+    """Grace between SIGTERM and SIGKILL for a timed-out child: the
+    child's SIGTERM handler (install_graceful_term) exits after the
+    in-flight device op returns, so the grace must cover one
+    worst-case device program.  SIGKILL is the very last resort —
+    killing a chip-holding process mid-op wedges the tunnel for
+    everyone."""
+    return hatch_int("POSEIDON_BENCH_TERM_GRACE")
+
 
 
 def _prework_allowance() -> int:
@@ -98,10 +112,9 @@ def _prework_allowance() -> int:
     their measured work immediately.  Evaluated at child-launch time —
     the parent latches the verdict AFTER this module loads.
     """
-    if os.environ.get("POSEIDON_BENCH_NO_PROBE"):
+    if hatch_flag("POSEIDON_BENCH_NO_PROBE"):
         return 0
-    return int(float(os.environ.get("POSEIDON_DEVICE_LOCK_TIMEOUT", "600"))
-               ) + 300
+    return int(hatch_float("POSEIDON_DEVICE_LOCK_TIMEOUT")) + 300
 
 
 def _probe_matmul() -> bool:
@@ -180,7 +193,7 @@ def _parent_probe_and_latch() -> None:
       CPU one, so every child inherits `backend: "cpu"` without spending
       a single additional probe second on the dead tunnel.
     """
-    if os.environ.get("POSEIDON_BENCH_NO_PROBE"):
+    if hatch_flag("POSEIDON_BENCH_NO_PROBE"):
         return  # operator already latched a verdict (CPU dry-run mode)
     from poseidon_tpu.utils.envutil import (
         clean_cpu_env,
@@ -232,7 +245,7 @@ def _ensure_live_backend() -> None:
     accelerator is dead — same semantics the parent applies, in process-
     replacement form because jax may already be importable.
     """
-    if os.environ.get("POSEIDON_BENCH_NO_PROBE"):
+    if hatch_flag("POSEIDON_BENCH_NO_PROBE"):
         return
     before = dict(os.environ)
     _parent_probe_and_latch()
@@ -560,7 +573,10 @@ def run_features(machines: int, rounds: int) -> dict:
     """
     import jax
 
-    from poseidon_tpu.check.ledger import CompileLedger
+    from poseidon_tpu.check.ledger import (
+        CompileLedger,
+        TransferLedger,
+    )
     from poseidon_tpu.costmodel import get_cost_model
     from poseidon_tpu.costmodel.selectors import IN_SET
     from poseidon_tpu.graph.instance import RoundPlanner
@@ -631,7 +647,9 @@ def run_features(machines: int, rounds: int) -> dict:
             # round") enforced in-band — a retrace regression fails the
             # bench with the compiled program names, instead of hiding
             # in round_p50_s the way the 15.2 s gang round did.
-            with CompileLedger(budget=0, label=f"warm selector round {r}"):
+            with CompileLedger(budget=0, label=f"warm selector round {r}"), \
+                    TransferLedger(
+                        budget=0, label=f"warm selector round {r}"):
                 _, m = planner.schedule_round()
         lat.append(time.perf_counter() - t0)
         fresh_per_round.append(m.fresh_compiles)
@@ -758,7 +776,8 @@ def run_features(machines: int, rounds: int) -> dict:
     # host-certified at every measured scale (PR 3: zero dispatches at
     # 10k) — so a fresh compile here IS the silent-retrace bug class,
     # asserted at budget 0 exactly like the warm rounds.
-    with CompileLedger(budget=0, label="gang round"):
+    with CompileLedger(budget=0, label="gang round"), \
+            TransferLedger(budget=0, label="gang round"):
         _, mg = planner.schedule_round()
     gang_s = time.perf_counter() - t0
     partial_gangs = placed_gangs = 0
@@ -993,9 +1012,9 @@ def _child(mode: str, argv: list, timeout: int) -> dict:
             timed_out = True
             proc.terminate()
             try:
-                out, err = proc.communicate(timeout=TERM_GRACE_S)
+                out, err = proc.communicate(timeout=term_grace_s())
             except subprocess.TimeoutExpired:
-                print(f"# child {mode} ignored SIGTERM for {TERM_GRACE_S}s "
+                print(f"# child {mode} ignored SIGTERM for {term_grace_s()}s "
                       "(wedged tunnel?); escalating to SIGKILL",
                       file=sys.stderr)
                 proc.kill()
@@ -1121,7 +1140,7 @@ def main(argv=None) -> int:
         res = _stage("rung", [
             "--machines", str(machines), "--tasks", str(tasks),
             "--ecs", str(args.ecs), "--rounds", str(args.rounds),
-        ] + (["--verbose"] if args.verbose else []), RUNG_TIMEOUT_S)
+        ] + (["--verbose"] if args.verbose else []), rung_timeout_s())
         res.setdefault("machines", machines)
         res.setdefault("tasks", tasks)
         rungs.append(res)
@@ -1152,7 +1171,7 @@ def main(argv=None) -> int:
     trace = _stage("trace", [
         "--machines", str(t_machines), "--tasks", str(t_tasks),
         "--rounds", str(max(args.rounds * 4, 12)),
-    ], RUNG_TIMEOUT_S)
+    ], rung_timeout_s())
     emit()
     if not args.machines:
         # Full-ladder mode only: single-config runs are quick focused
@@ -1164,7 +1183,7 @@ def main(argv=None) -> int:
         # hold at the scale the project's headline claims.
         features = _stage("features", [
             "--machines", "10000", "--rounds", "3",
-        ], FEATURES_TIMEOUT_S)
+        ], features_timeout_s())
         emit()
     for machines, tasks in ladder[1:]:
         run_rung_child(machines, tasks)

@@ -206,7 +206,10 @@ def run_soak(
     mutations; ``ctx`` exposes the live pieces (server, kube, poseidon,
     injector) so a test can, e.g., kill the Firmament stub mid-soak.
     """
-    from poseidon_tpu.check.ledger import fresh_compile_count
+    from poseidon_tpu.check.ledger import (
+        fresh_compile_count,
+        implicit_transfer_count,
+    )
     from poseidon_tpu.glue.fake_kube import FakeKube, Node, Pod
     from poseidon_tpu.glue.poseidon import Poseidon
     from poseidon_tpu.ops.transport import bucket_size
@@ -231,7 +234,8 @@ def run_soak(
         "ok": False, "plan": plan, "seed": seed, "machines": machines,
         "rounds_requested": rounds, "rounds_run": 0,
         "families_covered": list(fault_plan.families_covered()),
-        "digests": [], "warm_fresh_compiles": 0, "tiers": [],
+        "digests": [], "warm_fresh_compiles": 0,
+        "warm_implicit_transfers": 0, "tiers": [],
         "divergent_rounds": 0, "cost_delta_hits": 0,
     }
     if expect_digests is not None:
@@ -363,6 +367,7 @@ def run_soak(
             poseidon.drain_watchers(timeout=30.0)
 
             fresh0 = fresh_compile_count()
+            transfers0 = implicit_transfer_count()
             for _attempt in range(cfg.crash_loop_budget + 1):
                 delay = poseidon.try_round()
                 if delay is None:
@@ -374,8 +379,14 @@ def run_soak(
                 # Failed round: the soak compresses the backoff delay
                 # (the policy fired; sleeping it for real buys nothing).
             fresh = fresh_compile_count() - fresh0
+            transfers = implicit_transfer_count() - transfers0
             if r >= 1:
                 result["warm_fresh_compiles"] += fresh
+                # The transfer budget-0 window rides NEXT to the compile
+                # one: a warm soak round doing implicit device->host
+                # syncs is the same silent-latency bug class
+                # (TransferLedger; posecheck transfer-discipline).
+                result["warm_implicit_transfers"] += transfers
 
             # Quiesce before the divergence gate: release chaos-held
             # event streams (their damage — a round solved on stale
@@ -405,6 +416,7 @@ def run_soak(
             # (retries, precompile, watcher work), not just the
             # planner's own solve window — record both.
             metrics_d["soak_fresh_compiles"] = fresh
+            metrics_d["soak_implicit_transfers"] = transfers
             result["tiers"].append(metrics.solve_tier)
             result["cost_delta_hits"] += metrics.cost_delta_hits
             digest = _digest(kube_truth)
@@ -460,6 +472,13 @@ def run_soak(
                     "fresh-compiles",
                     f"{result['warm_fresh_compiles']} fresh XLA compiles "
                     "in warm rounds (budget 0)",
+                    total_rounds,
+                )
+            if result["warm_implicit_transfers"]:
+                raise SoakFailure(
+                    "implicit-transfers",
+                    f"{result['warm_implicit_transfers']} implicit "
+                    "device->host sync(s) in warm rounds (budget 0)",
                     total_rounds,
                 )
         result["ok"] = True

@@ -1,12 +1,13 @@
 """posecheck: codebase-aware static analysis for poseidon_tpu.
 
-Five rules, each scoped to the subsystem whose failure mode it guards
+Eight rules, each scoped to the subsystem whose failure mode it guards
 (see docs/CHECKS.md):
 
 - ``jit-purity``   — host-sync escapes inside jitted solver kernels
                      (``ops/``, ``solver/``);
 - ``lock-discipline`` — unlocked writes to lock-guarded state in the
-                     threaded glue layer (``glue/``);
+                     threaded layers (``glue/``, ``graph/pipeline.py``,
+                     ``costmodel/delta.py``, ``chaos/soak.py``);
 - ``determinism``  — wall clock / unseeded RNG / unordered-set iteration
                      / import-time env reads in the replay, planning,
                      and kernel paths (``replay/``, ``graph/``,
@@ -17,12 +18,27 @@ Five rules, each scoped to the subsystem whose failure mode it guards
                      ``graph/``);
 - ``dispatch-budget`` — every jitted kernel in ``ops/`` must be
                      reachable from the precompile path (cross-file
-                     closure; judged in ``Rule.finalize``).
+                     closure; judged in ``Rule.finalize``);
+- ``transfer-discipline`` — implicit device->host syncs (scalar
+                     coercions / np materialization of jitted results
+                     outside the declared ``host_fetch`` boundary) and
+                     missed/misused donation (``ops/``, ``graph/``,
+                     ``costmodel/``);
+- ``shard-discipline`` — collectives under shard_map scope with
+                     declared mesh axes, PartitionSpec consistency,
+                     pad-to-mesh-multiple at sharded boundaries, and
+                     precompile reachability for sharded kernels;
+- ``hatch-registry`` — every ``POSEIDON_*`` escape hatch reads through
+                     the typed call-time registry
+                     (``utils/hatches.py``); bypasses, undeclared
+                     names, and dead flags are findings.
 
 The runtime complement is ``poseidon_tpu.check.ledger``: a
 ``jax.monitoring``-fed ``CompileLedger`` asserting exact fresh-compile
-budgets around warm rounds (imported separately — it pulls in jax,
-which the static CLI deliberately does not).
+budgets and a transfer-guard/interposer-fed ``TransferLedger``
+asserting implicit device->host-sync budgets around warm rounds
+(imported separately — it pulls in jax, which the static CLI
+deliberately does not).
 
 CLI: ``python -m poseidon_tpu.check poseidon_tpu/`` (exit 1 on findings;
 ``--format=json`` for machines, ``--changed`` for pre-commit speed).

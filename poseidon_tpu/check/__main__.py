@@ -74,7 +74,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m poseidon_tpu.check",
         description="posecheck: jit-purity / lock-discipline / determinism"
-                    " / retrace-guard / dispatch-budget",
+                    " / retrace-guard / dispatch-budget /"
+                    " transfer-discipline / shard-discipline /"
+                    " hatch-registry",
     )
     parser.add_argument(
         "paths", nargs="*", default=["poseidon_tpu/"],

@@ -119,9 +119,14 @@ def all_rules() -> List[Rule]:
     # Local imports: the rule modules import this one for Rule/Finding.
     from poseidon_tpu.check.determinism import DeterminismRule
     from poseidon_tpu.check.dispatch_budget import DispatchBudgetRule
+    from poseidon_tpu.check.hatch_registry import HatchRegistryRule
     from poseidon_tpu.check.jit_purity import JitPurityRule
     from poseidon_tpu.check.lock_discipline import LockDisciplineRule
     from poseidon_tpu.check.retrace_guard import RetraceGuardRule
+    from poseidon_tpu.check.shard_discipline import ShardDisciplineRule
+    from poseidon_tpu.check.transfer_discipline import (
+        TransferDisciplineRule,
+    )
 
     return [
         JitPurityRule(),
@@ -129,6 +134,9 @@ def all_rules() -> List[Rule]:
         DeterminismRule(),
         RetraceGuardRule(),
         DispatchBudgetRule(),
+        TransferDisciplineRule(),
+        ShardDisciplineRule(),
+        HatchRegistryRule(),
     ]
 
 

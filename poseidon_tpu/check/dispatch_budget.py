@@ -90,7 +90,10 @@ class DispatchBudgetRule(Rule):
     # under _FLAG_FRAGMENT are ever flagged.
     scopes: tuple = ()
 
-    _SEED_NAMES = ("precompile",)
+    # ensure_precompiled joined in PR 11: the service's eager warm-up
+    # entry point (server.py) is a first-class seed — a kernel wired
+    # only through it is covered, not orphaned.
+    _SEED_NAMES = ("precompile", "ensure_precompiled")
 
     def __init__(self, flag_fragments=("poseidon_tpu/ops/",)) -> None:
         # Jitted defs are only FLAGGED in files matching these fragments

@@ -1,0 +1,71 @@
+"""shard-discipline violation fixture: seeded mesh-hygiene breaks.
+
+Expected findings (tests/test_check_selfcheck.py asserts these):
+  - collective naming an axis no mesh declares                    (1)
+  - collective outside any shard_map/mesh scope                   (1)
+  - PartitionSpec axis not drawn from a declared mesh             (1)
+  - NamedSharding + device_put with no pad-to-mesh-multiple       (1)
+  - sharded jitted def unreachable from precompile                (1)
+  - ``covered_sharded`` is precompile-reachable: no finding
+  - ``opted_out_sharded`` carries ignore[dispatch-budget]: no finding
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MACHINE_AXIS = "machines"
+
+
+@jax.jit
+def covered_sharded(cols):
+    return cols + 1
+
+
+@jax.jit
+def orphan_sharded(cols):
+    # VIOLATION: sharded jitted def precompile never reaches.
+    return cols - 1
+
+
+@jax.jit
+def opted_out_sharded(cols):  # posecheck: ignore[dispatch-budget]
+    return cols * 3
+
+
+def make_mesh():
+    return Mesh(np.asarray(jax.devices()), (MACHINE_AXIS,))
+
+
+def wrapped_wrong_axis(mesh):
+    def body(x):
+        # VIOLATION: "rows" is not a declared mesh axis.
+        return lax.psum(jnp.sum(x), "rows")
+
+    return shard_map(
+        body, mesh=mesh, in_specs=P(MACHINE_AXIS), out_specs=P()
+    )
+
+
+def stray_collective(x):
+    # VIOLATION: a collective outside any shard_map-scoped function.
+    return lax.psum(x, MACHINE_AXIS)
+
+
+def bad_spec(mesh):
+    # VIOLATION: PartitionSpec names an axis no mesh declares.
+    return NamedSharding(mesh, P("bogus_axis"))
+
+
+def unpadded_upload(costs, mesh):
+    # VIOLATION: NamedSharding + device_put with no visible
+    # pad-to-mesh-multiple computation or divisibility guard.
+    col = NamedSharding(mesh, P(None, MACHINE_AXIS))
+    return jax.device_put(jnp.asarray(costs), col)
+
+
+def precompile():
+    return covered_sharded(jnp.zeros(4))

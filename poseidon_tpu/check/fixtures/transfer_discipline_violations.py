@@ -1,0 +1,50 @@
+"""transfer-discipline violation fixture: seeded implicit syncs.
+
+Expected findings (tests/test_check_selfcheck.py asserts these):
+  - scalar syncs on jitted-call results: float / item / int / tolist (4)
+  - np materialization of a jitted result outside a boundary       (1)
+  - jax.device_get outside a declared boundary                     (1)
+  - in-place ``.at`` update without donate_argnums                 (1)
+  - use-after-donation of a donated operand                        (1)
+  - the suppressed np.asarray does NOT count
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _kernel(x):
+    return x * 2, x.sum()
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter(buf, val):
+    return buf.at[0].set(val)
+
+
+@jax.jit
+def _inplace_no_donate(buf, val):
+    # VIOLATION: .at update of an operand with no donate_argnums.
+    return buf.at[0].set(val)
+
+
+def leaky_wrapper(x):
+    F, s = _kernel(x)
+    a = float(s)                  # VIOLATION: implicit scalar sync
+    b = s.item()                  # VIOLATION: implicit scalar sync
+    c = int(F[0, 0])              # VIOLATION: implicit scalar sync
+    lst = F.tolist()              # VIOLATION: implicit scalar sync
+    host = np.asarray(F)          # VIOLATION: implicit materialization
+    got = jax.device_get(s)       # VIOLATION: device_get off-boundary
+    ok = np.asarray(F)            # posecheck: ignore[transfer-discipline]
+    return a, b, c, lst, host, got, ok
+
+
+def reuse_after_donate(x):
+    buf = jnp.zeros(4)
+    out = _scatter(buf, x)
+    return buf.sum() + out.sum()  # VIOLATION: buf was donated

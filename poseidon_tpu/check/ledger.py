@@ -1,4 +1,5 @@
-"""Runtime compile ledger: assert an exact fresh-XLA-compile budget.
+"""Runtime compile + transfer ledgers: exact budgets for the two silent
+per-round costs — fresh XLA compiles and implicit device->host syncs.
 
 The static rules (``retrace-guard``, ``dispatch-budget``) catch the
 *patterns* that mint compile keys; this module catches the *events*.
@@ -34,6 +35,27 @@ Listener registration is lazy (first use) and permanent:
 module-level hook dispatches to whatever ledgers are active — cheap
 enough (an int bump on a compile, which costs milliseconds anyway) to
 leave installed.
+
+``TransferLedger`` is the transfer-side twin (the runtime complement of
+the ``transfer-discipline`` static rule): it counts *implicit*
+device->host synchronizations in a window and asserts a budget.  Two
+detection layers, because no single mechanism covers every backend:
+
+- ``jax.transfer_guard_device_to_host("disallow")`` held open for
+  budget-0 windows — on accelerator backends any implicit d2h copy
+  (``np.asarray`` on a device array, buffer-protocol reads) raises at
+  the offending op with jax's own description.  On the CPU backend
+  these conversions are zero-copy and the guard never consults — which
+  is why a second layer exists;
+- an install-once interposer over the scalar-sync methods jax itself
+  attaches to its array class (``item``/``tolist``/``__float__``/
+  ``__int__``/``__bool__``/``__index__``): each call is a blocking
+  device->host sync on EVERY backend (a ~60-150 ms tunnel slot on the
+  production TPU), counted process-wide exactly like
+  ``fresh_compile_count`` and attributed to the offending call site in
+  the budget report.  Explicit fetches (``jax.device_get``,
+  ``transport.host_fetch``) return numpy and are never counted — that
+  is the declared-boundary discipline the static rule enforces.
 """
 
 from __future__ import annotations
@@ -205,5 +227,179 @@ class CompileLedger:
                 "new compile keys — look for shape/dtype/static-arg "
                 "drift at the jit boundary (posecheck retrace-guard "
                 "names the static patterns)."
+            )
+        return False
+
+
+# ------------------------------------------------------------ transfers
+
+# Scalar-coercion methods jax attaches (in Python) to its array class;
+# each call blocks the host on the device queue and ships the value —
+# an implicit device->host sync on every backend.
+_SYNC_METHODS = (
+    "item", "tolist", "__float__", "__int__", "__bool__", "__index__",
+)
+
+_sync_installed = False
+_transfer_count = 0
+_transfer_active: List["TransferLedger"] = []
+
+
+def _describe_sync(method: str, arr) -> str:
+    """`float() on int32[] at instance.py:812` — the actionable half of
+    a budget failure.  Stack walk only happens on a counted sync with a
+    ledger open, so the cost sits on the already-expensive path."""
+    import traceback
+
+    try:
+        shape = getattr(arr, "shape", ())
+        dtype = getattr(arr, "dtype", "?")
+        desc = f"{method}() on {dtype}{list(shape)}"
+    except Exception:  # noqa: BLE001 - description must never raise
+        desc = f"{method}()"
+    try:
+        for frame in reversed(traceback.extract_stack(limit=16)):
+            fn = frame.filename.replace("\\", "/")
+            if "check/ledger.py" in fn or "/jax/" in fn \
+                    or "jax/_src" in fn:
+                continue
+            return f"{desc} at {fn.rsplit('/', 1)[-1]}:{frame.lineno}"
+    except Exception:  # noqa: BLE001
+        pass
+    return desc
+
+
+def _note_sync(method: str, arr) -> None:
+    # The description (a stack walk) is built OUTSIDE the lock; the
+    # counter bumps and offender appends happen under it — the round's
+    # assign-pool and pipeline worker threads sync concurrently with
+    # the main thread, and a lost increment would pass the exact
+    # violation the budget-0 gate exists to catch.
+    global _transfer_count
+    with _lock:
+        active = list(_transfer_active)
+    desc = _describe_sync(method, arr) if active else ""
+    with _lock:
+        _transfer_count += 1
+        for led in _transfer_active:
+            led._note(desc)
+
+
+def _ensure_sync_interposer() -> None:
+    """Patch the scalar-sync methods once, permanently (the compile
+    listener's install posture).  Backend-free: the array class comes
+    from ``jax._src.array``, so a process that must never touch the
+    accelerator can still install the counter."""
+    global _sync_installed
+    if _sync_installed:
+        return
+    with _lock:
+        if _sync_installed:
+            return
+        from jax._src.array import ArrayImpl
+
+        for name in _SYNC_METHODS:
+            orig = getattr(ArrayImpl, name, None)
+            if orig is None:
+                continue
+
+            def make(method, orig):
+                def wrapper(self, *args, **kwargs):
+                    _note_sync(method, self)
+                    return orig(self, *args, **kwargs)
+
+                wrapper.__name__ = getattr(orig, "__name__", method)
+                wrapper.__qualname__ = getattr(
+                    orig, "__qualname__", method
+                )
+                wrapper._poseidon_sync_orig = orig
+                return wrapper
+
+            setattr(ArrayImpl, name, make(name, orig))
+        _sync_installed = True
+
+
+def implicit_transfer_count() -> int:
+    """Process-wide count of implicit device->host scalar syncs since
+    the first ledger/counter use.  Difference around a window exactly
+    like ``fresh_compile_count`` — ``RoundMetrics.implicit_transfers``
+    is wired this way."""
+    _ensure_sync_interposer()
+    return _transfer_count
+
+
+class TransferBudgetExceeded(AssertionError):
+    """A ledger window performed more implicit device->host syncs than
+    budgeted."""
+
+
+class TransferLedger:
+    """Context manager asserting an implicit-transfer budget.
+
+    >>> with TransferLedger(budget=0, label="warm gang round"):
+    ...     planner.schedule_round()
+
+    ``budget=None`` records without asserting (telemetry mode) and holds
+    no transfer guard, so production rounds can ride it for free.  With
+    ``budget=0`` the window additionally holds
+    ``jax.transfer_guard_device_to_host("disallow")``, so on accelerator
+    backends even interposer-invisible implicit copies (buffer-protocol
+    ``np.asarray``) raise at the op; explicit ``jax.device_get`` — the
+    ``transport.host_fetch`` boundary — stays legal.  The exit assertion
+    names each offending sync with its call site.
+    """
+
+    def __init__(self, budget: Optional[int] = 0, label: str = ""):
+        self.budget = budget
+        self.label = label
+        self._implicit = 0
+        self.offenders: List[str] = []
+        self._guard_ctx = None
+
+    @property
+    def implicit_transfers(self) -> int:
+        return self._implicit
+
+    def _note(self, desc: str) -> None:
+        # Called under the module _lock (see _note_sync).
+        self._implicit += 1
+        if len(self.offenders) < 32:  # cap the report, not the count
+            # "" happens only for a ledger that registered between the
+            # active-check and the note (no description was built).
+            self.offenders.append(desc or "<unattributed sync>")
+
+    def __enter__(self) -> "TransferLedger":
+        _ensure_sync_interposer()
+        if self.budget == 0:
+            # The guard raises AT the op, so it can only express a
+            # zero budget; positive budgets count via the interposer
+            # alone and settle at __exit__.
+            import jax
+
+            self._guard_ctx = jax.transfer_guard_device_to_host(
+                "disallow"
+            )
+            self._guard_ctx.__enter__()
+        with _lock:
+            _transfer_active.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        with _lock:
+            if self in _transfer_active:
+                _transfer_active.remove(self)
+        if self._guard_ctx is not None:
+            self._guard_ctx.__exit__(exc_type, exc, tb)
+            self._guard_ctx = None
+        if exc_type is None and self.budget is not None \
+                and self._implicit > self.budget:
+            where = f" in {self.label}" if self.label else ""
+            names = "; ".join(self.offenders) or "<not attributed>"
+            raise TransferBudgetExceeded(
+                f"{self._implicit} implicit device->host sync(s){where}, "
+                f"budget {self.budget}: {names}.  Each is a blocking "
+                "tunnel round trip — batch the values into the explicit "
+                "boundary fetch (transport.host_fetch; posecheck "
+                "transfer-discipline names the static patterns)."
             )
         return False

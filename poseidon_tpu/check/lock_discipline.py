@@ -183,7 +183,15 @@ class LockDisciplineRule(Rule):
     # shares the plane cache with the planner thread — its lock
     # discipline (every cache touch joins the outstanding future under
     # _lock) is exactly this rule's compound-invariant territory.
-    scopes = ("poseidon_tpu/glue/", "poseidon_tpu/graph/pipeline.py")
+    # costmodel/delta.py and chaos/soak.py joined in PR 11: the plane
+    # cache is mutated from both the pipeline worker and the planner
+    # thread, and the soak harness drives watcher + loop threads over
+    # shared round state — both are threaded consumers added since the
+    # rule's PR 1 scope was drawn.
+    scopes = (
+        "poseidon_tpu/glue/", "poseidon_tpu/graph/pipeline.py",
+        "poseidon_tpu/costmodel/delta.py", "poseidon_tpu/chaos/soak.py",
+    )
 
     def check(self, tree: ast.AST, source: str, path: str) -> List[Finding]:
         factories = _lock_factory_names(tree)

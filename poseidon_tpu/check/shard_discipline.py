@@ -1,0 +1,462 @@
+"""shard-discipline: mesh/collective/sharding hygiene for multi-device code.
+
+The ROADMAP's top open item grafts the sharded band solve
+(``ops/transport_sharded.py``) into the planner as a fourth ladder tier
+— which means multi-device programs join the compile-key /
+``ensure_precompiled`` / budget-0 discipline the single-chip kernels
+already live under.  The failure modes are sharding-specific and all
+silent on a single-device CI box:
+
+- a collective (``psum``/``all_gather``/``ppermute``/...) naming an
+  axis that no declared mesh carries traces fine in single-device tests
+  (jax binds the axis lazily) and dies — or worse, silently reduces
+  over the wrong axis — on the real mesh;
+- a collective in a function that is never wrapped in
+  ``shard_map``/``pmap`` relies on being inlined into some caller's
+  mesh scope: refactor the caller and the kernel breaks;
+- a ``PartitionSpec`` naming an unknown axis silently replicates (XLA
+  treats it as an unpartitioned dim on meshes without the axis);
+- a sharded jit boundary whose operand extent is not padded to a mesh
+  multiple fails with an uneven-sharding error only at the first real
+  multi-device run (``transport_sharded`` rounds ``m_pad`` up to a mesh
+  multiple for exactly this reason);
+- a sharded jitted def outside the precompile closure ships PR 3's
+  silent-first-dispatch-compile failure mode to the multi-device tier,
+  where a fresh compile through the tunnel costs minutes, not seconds.
+
+Axis declarations are collected ACROSS the scan (``finalize``-judged,
+like ``dispatch-budget``): module constants named ``*_AXIS`` bound to a
+string literal, plus literal axis-name tuples/lists in ``Mesh(...)``
+constructions — so ``transport_sharded.MACHINE_AXIS`` is visible to
+every scanned file that imports it.  The reachability sub-check reuses
+the dispatch-budget seeds (``precompile``/``ensure_precompiled``) and
+honors BOTH ``ignore[shard-discipline]`` and
+``ignore[dispatch-budget]`` on the def line (a deliberately
+dispatch-time-compiled sharded kernel is the same opt-out either way).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from poseidon_tpu.check.core import (
+    Finding,
+    Rule,
+    dotted_name,
+    from_imports,
+    suppressions,
+)
+from poseidon_tpu.check.dispatch_budget import _referenced_names
+from poseidon_tpu.check.jit_purity import (
+    _is_jit_expr,
+    _jit_names,
+    _partial_names,
+)
+
+# lax/jax collectives whose axis_name argument must match a declared
+# mesh axis.  (name -> axis_name positional index when passed
+# positionally; None = keyword-only in practice.)
+_COLLECTIVES: Dict[str, Optional[int]] = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
+    "all_gather": 1, "all_to_all": 1, "ppermute": 1, "pshuffle": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+
+_SHARD_MAP_NAMES = ("shard_map", "smap")
+
+
+@dataclass
+class _FileFacts:
+    path: str
+    # function name -> referenced names (for the precompile closure)
+    refs: Dict[str, Set[str]] = field(default_factory=dict)
+    defs: Set[str] = field(default_factory=set)
+    # sharded jitted defs: name -> lineno
+    sharded_jitted: Dict[str, int] = field(default_factory=dict)
+    # lines suppressed for this rule OR dispatch-budget
+    suppressed: Set[int] = field(default_factory=set)
+    # collected collective call sites:
+    # (lineno, collective, axis literal or None, in_mesh_scope)
+    collectives: List[Tuple[int, str, Optional[str], bool]] = \
+        field(default_factory=list)
+    # PartitionSpec literal axis uses: (lineno, axis)
+    spec_axes: List[Tuple[int, str]] = field(default_factory=list)
+    # declared axis names (module constants + Mesh constructions)
+    declared_axes: Set[str] = field(default_factory=set)
+    # functions that build NamedSharding + device_put without a visible
+    # pad-to-multiple: (lineno, fn name)
+    unpadded: List[Tuple[int, str]] = field(default_factory=list)
+
+
+def _ceil_multiple_present(fn: ast.AST) -> bool:
+    """True when the function body contains a visible pad-to-multiple
+    computation: ``((a + b - 1) // b) * b``, ``-(-a // b) * b``, a
+    ``math.ceil(a / b) * b``, or an explicit ``% b == 0`` divisibility
+    guard."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+            left, right = node.left, node.right
+            for a, b in ((left, right), (right, left)):
+                if isinstance(a, ast.BinOp) and isinstance(
+                    a.op, ast.FloorDiv
+                ):
+                    return True
+        if isinstance(node, ast.Compare) and isinstance(
+            node.left, ast.BinOp
+        ) and isinstance(node.left.op, ast.Mod):
+            if any(
+                isinstance(c, ast.Constant) and c.value == 0
+                for c in node.comparators
+            ):
+                return True
+    return False
+
+
+class ShardDisciplineRule(Rule):
+    name = "shard-discipline"
+    # Facts collect everywhere (axis constants can live anywhere);
+    # collectives/specs are only FLAGGED under these fragments.
+    scopes: tuple = ()
+
+    _SEED_NAMES = ("precompile", "ensure_precompiled")
+
+    def __init__(self, flag_fragments=("poseidon_tpu/",)) -> None:
+        self._flag_fragments = tuple(flag_fragments)
+        self._files: List[_FileFacts] = []
+        self._dir_roots = None
+
+    def begin(self, paths: Sequence[str]) -> None:
+        # Same partial-graph posture as dispatch-budget: reachability
+        # and cross-file axis declarations are only judged for files
+        # under a directory scan root.
+        from pathlib import Path
+
+        self._dir_roots = [
+            Path(p).resolve() for p in paths if Path(p).is_dir()
+        ]
+
+    # ---------------------------------------------------------------- check
+
+    def check(self, tree: ast.AST, source: str, path: str) -> List[Finding]:
+        assert isinstance(tree, ast.Module)
+        facts = _FileFacts(path=path)
+        for lineno, rules in suppressions(source).items():
+            if rules is None or rules & {self.name, "dispatch-budget"}:
+                facts.suppressed.add(lineno)
+
+        jit = _jit_names(tree)
+        partials = _partial_names(tree)
+        shard_wrapped: Set[str] = set()
+        uses_sharding = False
+
+        # Declared axes: X_AXIS = "name" constants; Mesh(..., (names,)).
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Constant
+            ) and isinstance(node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id.endswith("_AXIS"):
+                        facts.declared_axes.add(node.value.value)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                fname = dotted_name(node.func) or ""
+                tail = fname.rpartition(".")[2]
+                if tail == "Mesh":
+                    for arg in list(node.args[1:2]) + [
+                        kw.value for kw in node.keywords
+                        if kw.arg == "axis_names"
+                    ]:
+                        if isinstance(arg, (ast.Tuple, ast.List)):
+                            for e in arg.elts:
+                                if isinstance(e, ast.Constant) and \
+                                        isinstance(e.value, str):
+                                    facts.declared_axes.add(e.value)
+                        elif isinstance(arg, ast.Constant) and \
+                                isinstance(arg.value, str):
+                            facts.declared_axes.add(arg.value)
+                if tail in ("NamedSharding", "PartitionSpec") or (
+                    tail == "P" and self._p_is_partition_spec(tree)
+                ):
+                    uses_sharding = True
+
+        # shard_map-wrapped functions: decorators and g = shard_map(f,…)
+        def is_shard_map(expr: ast.AST) -> bool:
+            if isinstance(expr, ast.Call):
+                t = (dotted_name(expr.func) or "").rpartition(".")[2]
+                return t in _SHARD_MAP_NAMES
+            return False
+
+        # shard_map-wrapped functions: decorators plus ANY
+        # ``shard_map(f, ...)`` call in the module — including the
+        # nested-closure idiom ``return shard_map(body, mesh=...)``
+        # where ``body`` is a local def.
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef):
+                if any(is_shard_map(d) for d in node.decorator_list):
+                    shard_wrapped.add(node.name)
+            elif isinstance(node, ast.Call) and is_shard_map(node):
+                inner = node.args[0] if node.args else None
+                nm = dotted_name(inner) if inner is not None else None
+                if nm and "." not in nm:
+                    shard_wrapped.add(nm)
+
+        # Mesh-scope closure: shard_wrapped functions plus everything
+        # they reference (the jit-purity closure shape).  The table
+        # includes NESTED defs — a shard_map'ed local closure pulls its
+        # module-level helpers into scope too.
+        table: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef):
+                table[node.name] = node
+                facts.refs.setdefault(node.name, set()).update(
+                    _referenced_names(node)
+                )
+                facts.defs.add(node.name)
+
+        mesh_scope: Set[str] = set()
+        frontier = [n for n in shard_wrapped if n in table]
+        while frontier:
+            nm = frontier.pop()
+            if nm in mesh_scope:
+                continue
+            mesh_scope.add(nm)
+            for ref in facts.refs.get(nm, ()):
+                if ref in table and ref not in mesh_scope:
+                    frontier.append(ref)
+
+        # Sharded jitted defs (for the reachability sub-check) + the
+        # divisibility heuristic, judged per jitted/sharding function.
+        p_spec = self._p_is_partition_spec(tree)
+        for node in tree.body:
+            defs: List[ast.FunctionDef] = []
+            if isinstance(node, ast.FunctionDef):
+                defs = [node]
+            elif isinstance(node, ast.ClassDef):
+                defs = [
+                    s for s in node.body
+                    if isinstance(s, ast.FunctionDef)
+                ]
+            for fn in defs:
+                jitted = any(
+                    _is_jit_expr(d, jit, partials)
+                    for d in fn.decorator_list
+                )
+                body_shards = self._fn_uses_sharding(fn, p_spec)
+                if jitted and (uses_sharding or fn.name in shard_wrapped):
+                    facts.sharded_jitted[fn.name] = fn.lineno
+                if body_shards["named_sharding"] and \
+                        body_shards["device_put"] and \
+                        not _ceil_multiple_present(fn):
+                    facts.unpadded.append((fn.lineno, fn.name))
+
+        # Collective + PartitionSpec call sites (with mesh-scope info).
+        self._collect_sites(tree, table, mesh_scope, facts, p_spec)
+
+        self._files.append(facts)
+        return []
+
+    @staticmethod
+    def _p_is_partition_spec(tree: ast.AST) -> bool:
+        """True when ``P`` is bound to PartitionSpec in this module
+        (``from jax.sharding import PartitionSpec as P``)."""
+        for mod in ("jax.sharding", "jax.experimental.pjit"):
+            for local, orig in from_imports(tree, mod).items():
+                if orig == "PartitionSpec" and local == "P":
+                    return True
+        return False
+
+    @staticmethod
+    def _fn_uses_sharding(fn: ast.AST, p_spec: bool) -> Dict[str, bool]:
+        out = {"named_sharding": False, "device_put": False}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                tail = (dotted_name(node.func) or "").rpartition(".")[2]
+                if tail == "NamedSharding":
+                    out["named_sharding"] = True
+                elif tail == "device_put":
+                    out["device_put"] = True
+        return out
+
+    def _collect_sites(self, tree, table, mesh_scope, facts,
+                       p_spec) -> None:
+        # Walk each function body (collectives outside any def — module
+        # level — are always outside mesh scope).
+        def visit(scope_name: Optional[str], node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.FunctionDef):
+                    visit(child.name, child)
+                    continue
+                if isinstance(child, ast.Call):
+                    self._classify_call(
+                        child, scope_name, mesh_scope, facts, p_spec
+                    )
+                visit(scope_name, child)
+
+        visit(None, tree)
+
+    def _classify_call(self, node, scope_name, mesh_scope, facts,
+                       p_spec) -> None:
+        fname = dotted_name(node.func) or ""
+        tail = fname.rpartition(".")[2]
+        if tail in _COLLECTIVES:
+            # Only count the real jax/lax collectives, not same-named
+            # local helpers: require a dotted path mentioning lax/jax
+            # or a bare name imported from jax.lax.
+            if "." in fname and not (
+                "lax" in fname or fname.startswith("jax.")
+            ):
+                return
+            axis: Optional[str] = None
+            pos = _COLLECTIVES[tail]
+            if pos is not None and len(node.args) > pos:
+                a = node.args[pos]
+                if isinstance(a, ast.Constant) and isinstance(
+                    a.value, str
+                ):
+                    axis = a.value
+            for kw in node.keywords:
+                if kw.arg == "axis_name" and isinstance(
+                    kw.value, ast.Constant
+                ) and isinstance(kw.value.value, str):
+                    axis = kw.value.value
+            in_scope = scope_name is not None and scope_name in mesh_scope
+            facts.collectives.append(
+                (node.lineno, tail, axis, in_scope)
+            )
+        elif tail == "PartitionSpec" or (tail == "P" and p_spec):
+            for a in node.args:
+                if isinstance(a, ast.Constant) and isinstance(
+                    a.value, str
+                ):
+                    facts.spec_axes.append((node.lineno, a.value))
+                elif isinstance(a, (ast.Tuple, ast.List)):
+                    for e in a.elts:
+                        if isinstance(e, ast.Constant) and isinstance(
+                            e.value, str
+                        ):
+                            facts.spec_axes.append(
+                                (node.lineno, e.value)
+                            )
+
+    # ------------------------------------------------------------- finalize
+
+    def _judgeable(self, path: str) -> bool:
+        if self._dir_roots is None:
+            return True
+        from pathlib import Path
+
+        try:
+            resolved = Path(path).resolve()
+        except OSError:
+            return False
+        return any(
+            root == resolved or root in resolved.parents
+            for root in self._dir_roots
+        )
+
+    def finalize(self) -> List[Finding]:
+        files, self._files = self._files, []
+        dir_roots, self._dir_roots = self._dir_roots, None
+
+        declared: Set[str] = set()
+        for f in files:
+            declared.update(f.declared_axes)
+
+        findings: List[Finding] = []
+
+        def in_flag_scope(f: _FileFacts) -> bool:
+            return any(
+                frag in f.path for frag in self._flag_fragments
+            )
+
+        for f in files:
+            if not in_flag_scope(f):
+                continue
+            for lineno, name, axis, in_scope in f.collectives:
+                if lineno in f.suppressed:
+                    continue
+                if axis is not None and axis not in declared:
+                    findings.append(Finding(
+                        f.path, lineno, self.name,
+                        f"collective `{name}` names axis `{axis}`, "
+                        "which no declared mesh carries (declared: "
+                        f"{sorted(declared) or 'none'}); use the "
+                        "shared axis constant (MACHINE_AXIS) so a mesh "
+                        "rename cannot orphan the collective",
+                    ))
+                if not in_scope:
+                    findings.append(Finding(
+                        f.path, lineno, self.name,
+                        f"collective `{name}` outside any shard_map/"
+                        "mesh-scoped function: it relies on being "
+                        "inlined into a caller's mesh scope, which a "
+                        "refactor silently breaks — wrap the kernel in "
+                        "shard_map (or suppress with a justification "
+                        "if the scope is established dynamically)",
+                    ))
+            for lineno, axis in f.spec_axes:
+                if lineno in f.suppressed:
+                    continue
+                if axis not in declared:
+                    findings.append(Finding(
+                        f.path, lineno, self.name,
+                        f"PartitionSpec axis `{axis}` is not a "
+                        "declared mesh axis (declared: "
+                        f"{sorted(declared) or 'none'}): an unknown "
+                        "axis silently replicates instead of sharding",
+                    ))
+            for lineno, fn_name in f.unpadded:
+                if lineno in f.suppressed:
+                    continue
+                findings.append(Finding(
+                    f.path, lineno, self.name,
+                    f"`{fn_name}` device_puts NamedSharding-annotated "
+                    "operands without a visible pad-to-mesh-multiple "
+                    "(`((n + d - 1) // d) * d` or a `% d == 0` guard): "
+                    "uneven shards fail at the first real multi-device "
+                    "run",
+                ))
+
+        # Reachability: sharded jitted defs must reach a precompile
+        # seed (same closure + partial-graph posture as dispatch-budget).
+        all_refs: Dict[str, Set[str]] = {}
+        defined: Set[str] = set()
+        for f in files:
+            defined.update(f.defs)
+            for name, refs in f.refs.items():
+                all_refs.setdefault(name, set()).update(refs)
+        seeds = [
+            s for s in self._SEED_NAMES
+            if any(s in f.defs for f in files)
+        ]
+        if seeds:
+            reached: Set[str] = set()
+            frontier = list(seeds)
+            while frontier:
+                nm = frontier.pop()
+                if nm in reached:
+                    continue
+                reached.add(nm)
+                for ref in all_refs.get(nm, ()):
+                    if ref in defined and ref not in reached:
+                        frontier.append(ref)
+            for f in files:
+                if not in_flag_scope(f) or not self._judgeable(f.path):
+                    continue
+                for name, lineno in sorted(f.sharded_jitted.items()):
+                    if name in reached or lineno in f.suppressed:
+                        continue
+                    findings.append(Finding(
+                        f.path, lineno, self.name,
+                        f"sharded jitted `{name}` is not reachable "
+                        "from precompile/ensure_precompiled: its first "
+                        "multi-device dispatch pays a fresh XLA "
+                        "compile through the tunnel (wire it in, or "
+                        "opt out with `# posecheck: "
+                        "ignore[dispatch-budget]` plus a "
+                        "justification)",
+                    ))
+        findings.sort(key=lambda x: (x.path, x.line, x.message))
+        return findings

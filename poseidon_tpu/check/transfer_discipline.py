@@ -1,0 +1,523 @@
+"""transfer-discipline: implicit device->host syncs and missed donation.
+
+Scope: ``poseidon_tpu/ops/``, ``poseidon_tpu/graph/``,
+``poseidon_tpu/costmodel/`` — the host-side round path AROUND the jitted
+kernels.  ``jit-purity`` guards code *inside* the jit scope; this rule
+guards the wrapper code that handles what comes back.  On the tunneled
+production TPU every device->host transfer is a ~60-150 ms latency slot
+(tools/profile_transfer.py), and the *implicit* ones are the killers: a
+``float(x)`` / ``.item()`` / ``np.asarray(x)`` on a jitted call's result
+blocks the host on the device queue and ships data with no visible
+smell at the call site — invisible on CPU tests, where the transfer is
+zero-copy.  The runtime twin is ``check.ledger.TransferLedger``
+(budget-0 windows around warm bench/soak rounds).
+
+Four sub-checks:
+
+- **scalar sync**: ``.item()`` / ``.tolist()`` / ``float()`` / ``int()``
+  / ``bool()`` applied to a value dataflow-traced from a jitted call
+  (module-local jit defs and ``g = jax.jit(f)`` wrappers, unioned
+  across the scan so imported kernels count).  Each is one blocking
+  round trip; batch the scalars into the result fetch instead.
+- **host materialization**: ``np.asarray`` / ``np.array`` /
+  ``np.ascontiguousarray`` on a jitted-call result outside a declared
+  host boundary.  The fetch itself is legitimate — ONCE, at the
+  boundary, explicitly — so it must route through
+  ``transport.host_fetch``/``_fetch_with_retry`` (which also carry the
+  transient-tunnel-error retry the ad-hoc ``np.asarray`` sites lack).
+- **device_get placement**: ``jax.device_get`` anywhere except a
+  declared host-boundary function (``host_fetch``, ``_fetch_with_retry``,
+  ``_host_*``, view builders).  Explicit transfers are the sanctioned
+  mechanism, but only at the boundary — scattered ``device_get`` calls
+  are scattered latency slots.
+- **donation**: a jitted def whose body updates one of its own operands
+  in place (``param.at[...]``) without ``donate_argnums`` allocates a
+  fresh device buffer for recurring state on every dispatch (the
+  resident-cache kernels donate for exactly this reason); and a
+  *use-after-donation* — reading a variable after passing it at a
+  donated position — consumes a deleted buffer (jax raises on
+  accelerators, silently copies on some backends).
+
+Dataflow is per-function and name-based (assignments from jitted calls,
+tuple unpacks, name aliases), resolved in ``finalize()`` against the
+scan-wide jitted-name union, so ``transport_sharded`` importing
+``_solve_device`` from ``transport`` is tracked.  Line-order is ignored
+inside a function (a name once bound to a device result stays tracked),
+which can over-approximate after rebinding — in practice the flagged
+expression IS the rebinding fetch.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from poseidon_tpu.check.core import (
+    Finding,
+    Rule,
+    dotted_name,
+    suppressions,
+)
+from poseidon_tpu.check.jit_purity import (
+    _is_jit_expr,
+    _jit_names,
+    _partial_names,
+)
+
+_NP_MATERIALIZERS = ("asarray", "array", "ascontiguousarray")
+_SCALAR_CASTS = ("float", "int", "bool")
+_SCALAR_METHODS = ("item", "tolist")
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """The base Name of an Attribute/Subscript chain, else None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _donation_spec(node: ast.AST) -> Optional[Tuple[Tuple[int, ...],
+                                                    Tuple[str, ...]]]:
+    """(donate_argnums, donate_argnames) parsed from a jit expression;
+    ``None`` when the expression carries no donation at all."""
+    if not isinstance(node, ast.Call):
+        return None
+    nums: Tuple[int, ...] = ()
+    names: Tuple[str, ...] = ()
+    found = False
+    for kw in node.keywords:
+        if kw.arg == "donate_argnums":
+            found = True
+            if isinstance(kw.value, ast.Tuple):
+                nums = tuple(
+                    e.value for e in kw.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)
+                )
+            elif isinstance(kw.value, ast.Constant) and isinstance(
+                kw.value.value, int
+            ):
+                nums = (kw.value.value,)
+        elif kw.arg == "donate_argnames":
+            found = True
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)
+            ) else [kw.value]
+            names = tuple(
+                e.value for e in vals
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            )
+    return (nums, names) if found else None
+
+
+def _jit_call_expr(node: ast.AST) -> Optional[ast.Call]:
+    """The innermost Call of a (possibly partial-wrapped) jit expression
+    whose keywords carry static_argnames/donate_argnums."""
+    if isinstance(node, ast.Call):
+        return node
+    return None
+
+
+@dataclass
+class _FnFacts:
+    path: str
+    fn: str
+    # (lineno, targets, kind "call"|"alias", payload callee/source name)
+    assigns: List[Tuple[int, Tuple[str, ...], str, str]] = \
+        field(default_factory=list)
+    # (lineno, kind, subject) — kind in {"scalar_name", "scalar_call",
+    # "np_name", "np_call"}; subject = tracked root name or callee name;
+    # detail = the operator for the message
+    sites: List[Tuple[int, str, str, str]] = field(default_factory=list)
+
+
+@dataclass
+class _FileFacts:
+    path: str
+    jitted: Set[str] = field(default_factory=set)
+    fns: List[_FnFacts] = field(default_factory=list)
+    suppressed: Set[int] = field(default_factory=set)
+
+
+class TransferDisciplineRule(Rule):
+    name = "transfer-discipline"
+    scopes = (
+        "poseidon_tpu/ops/", "poseidon_tpu/graph/",
+        "poseidon_tpu/costmodel/",
+    )
+
+    # Declared host boundaries: the functions allowed to materialize /
+    # device_get.  Prefix match on "_host_"/"host_" plus the explicit
+    # fetch/view builders.
+    _BOUNDARY_NAMES = frozenset({
+        "_fetch_with_retry", "host_fetch", "build_view",
+    })
+    _BOUNDARY_PREFIXES = ("_host_", "host_")
+
+    def __init__(self) -> None:
+        self._files: List[_FileFacts] = []
+
+    def _is_boundary(self, fn_name: str) -> bool:
+        return fn_name in self._BOUNDARY_NAMES or any(
+            fn_name.startswith(p) for p in self._BOUNDARY_PREFIXES
+        )
+
+    # ---------------------------------------------------------------- check
+
+    def check(self, tree: ast.AST, source: str, path: str) -> List[Finding]:
+        assert isinstance(tree, ast.Module)
+        jit = _jit_names(tree)
+        partials = _partial_names(tree)
+        np_aliases = {
+            a for node in ast.walk(tree) if isinstance(node, ast.Import)
+            for a in [al.asname or al.name for al in node.names
+                      if al.name == "numpy"]
+        }
+        jax_aliases = {
+            a for node in ast.walk(tree) if isinstance(node, ast.Import)
+            for a in [al.asname or al.name for al in node.names
+                      if al.name == "jax"]
+        } | {"jax"}
+
+        facts = _FileFacts(path=path)
+        for lineno, rules in suppressions(source).items():
+            if rules is None or self.name in rules:
+                facts.suppressed.add(lineno)
+
+        # Jitted defs + wrappers, and their donation specs.
+        donators: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]] = {}
+        arg_names: Dict[str, List[str]] = {}
+        jit_defs: List[Tuple[ast.FunctionDef, Optional[ast.Call]]] = []
+
+        def visit_def(node: ast.FunctionDef) -> None:
+            for d in node.decorator_list:
+                if _is_jit_expr(d, jit, partials):
+                    facts.jitted.add(node.name)
+                    arg_names[node.name] = [
+                        a.arg for a in node.args.args
+                    ]
+                    jit_defs.append((node, _jit_call_expr(d)))
+                    spec = _donation_spec(d)
+                    if spec:
+                        donators[node.name] = spec
+                    break
+
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                visit_def(node)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, ast.FunctionDef):
+                        visit_def(sub)
+            elif isinstance(node, ast.Assign):
+                v = node.value
+                if (
+                    isinstance(v, ast.Call)
+                    and _is_jit_expr(v.func, jit, partials)
+                    and v.args
+                ):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            facts.jitted.add(t.id)
+                            spec = _donation_spec(v)
+                            if spec:
+                                donators[t.id] = spec
+
+        findings: List[Finding] = []
+
+        # Donation sub-check 1: in-place .at[...] update of an operand
+        # in a jitted def with no donation.
+        for fn, jit_call in jit_defs:
+            if fn.name in donators:
+                continue
+            params = set(arg_names.get(fn.name, ()))
+            flagged: Set[str] = set()
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and node.attr == "at"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in params
+                    and node.value.id not in flagged
+                ):
+                    flagged.add(node.value.id)
+                    findings.append(Finding(
+                        path, fn.lineno, self.name,
+                        f"jitted `{fn.name}` updates operand "
+                        f"`{node.value.id}` in place (`.at[...]`) "
+                        "without donate_argnums: every dispatch "
+                        "allocates a fresh device buffer for recurring "
+                        "state — donate the operand (and never reuse "
+                        "it after the call)",
+                    ))
+
+        # Donation sub-check 2: use-after-donation at call sites of
+        # module-local donating kernels.
+        scopes: List[ast.AST] = [tree] + [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            findings.extend(self._check_use_after_donate(
+                scope, donators, arg_names, path
+            ))
+
+        # Dataflow facts for the cross-file scalar/np checks, plus
+        # immediate device_get placement findings.
+        self._collect_fn_facts(
+            tree, facts, np_aliases, jax_aliases, findings, path
+        )
+
+        self._files.append(facts)
+        # Donation/device_get findings are per-file: returned here so
+        # check_file's suppression filter applies normally.
+        return findings
+
+    # ------------------------------------------------- use-after-donation
+
+    def _check_use_after_donate(
+        self, scope, donators, arg_names, path
+    ) -> List[Finding]:
+        out: List[Finding] = []
+
+        def shallow(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                ):
+                    continue
+                yield child
+                yield from shallow(child)
+
+        donated_calls: List[Tuple[int, str, str]] = []  # line, var, callee
+        stores: Dict[str, List[int]] = {}
+        loads: Dict[str, List[int]] = {}
+        for node in shallow(scope):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Name
+            ) and node.func.id in donators:
+                nums, names = donators[node.func.id]
+                params = arg_names.get(node.func.id, [])
+                positions = set(nums) | {
+                    params.index(n) for n in names if n in params
+                }
+                for i, a in enumerate(node.args):
+                    if i in positions and isinstance(a, ast.Name):
+                        donated_calls.append(
+                            (node.lineno, a.id, node.func.id)
+                        )
+            elif isinstance(node, ast.Name):
+                d = stores if isinstance(
+                    node.ctx, (ast.Store, ast.Del)
+                ) else loads
+                d.setdefault(node.id, []).append(node.lineno)
+
+        for call_line, var, callee in donated_calls:
+            rebinds = [x for x in stores.get(var, []) if x >= call_line]
+            rebind_at = min(rebinds) if rebinds else None
+            for use_line in sorted(loads.get(var, [])):
+                if use_line <= call_line:
+                    continue
+                if rebind_at is not None and use_line >= rebind_at:
+                    break
+                out.append(Finding(
+                    path, use_line, self.name,
+                    f"`{var}` is read after being donated to "
+                    f"`{callee}` (line {call_line}): the buffer is "
+                    "deleted on accelerator backends — fetch what you "
+                    "need before the call or re-bind the result",
+                ))
+                break  # one finding per donated call is enough
+        return out
+
+    # ----------------------------------------------------- dataflow facts
+
+    def _collect_fn_facts(
+        self, tree, facts, np_aliases, jax_aliases, findings, path
+    ) -> None:
+        fns: List[Tuple[str, ast.AST]] = [("<module>", tree)]
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns.append((node.name, node))
+
+        def shallow(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                ):
+                    continue
+                yield child
+                yield from shallow(child)
+
+        for fn_name, scope in fns:
+            ff = _FnFacts(path=path, fn=fn_name)
+            boundary = self._is_boundary(fn_name)
+            for node in shallow(scope):
+                if isinstance(node, ast.Assign):
+                    targets: List[str] = []
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            targets.append(t.id)
+                        elif isinstance(t, (ast.Tuple, ast.List)):
+                            targets.extend(
+                                e.id for e in t.elts
+                                if isinstance(e, ast.Name)
+                            )
+                    if not targets:
+                        continue
+                    v = node.value
+                    if isinstance(v, ast.Call):
+                        callee = dotted_name(v.func)
+                        if callee:
+                            ff.assigns.append((
+                                node.lineno, tuple(targets), "call",
+                                callee.rpartition(".")[2],
+                            ))
+                    elif isinstance(v, ast.Name):
+                        ff.assigns.append(
+                            (node.lineno, tuple(targets), "alias", v.id)
+                        )
+                elif isinstance(node, ast.Call):
+                    self._classify_call(
+                        node, ff, boundary, np_aliases, jax_aliases,
+                        findings, path, fn_name,
+                    )
+            if ff.assigns or ff.sites:
+                facts.fns.append(ff)
+
+    def _classify_call(
+        self, node, ff, boundary, np_aliases, jax_aliases, findings,
+        path, fn_name,
+    ) -> None:
+        fname = dotted_name(node.func)
+        # jax.device_get placement: flagged immediately (no dataflow
+        # needed) unless inside a declared boundary.
+        if fname:
+            head, _, rest = fname.partition(".")
+            if head in jax_aliases and rest == "device_get":
+                if not boundary:
+                    findings.append(Finding(
+                        path, node.lineno, self.name,
+                        f"`{fname}()` outside a declared host boundary "
+                        f"(in `{fn_name}`): route the fetch through "
+                        "transport.host_fetch/_fetch_with_retry so "
+                        "transfers stay at the boundary (and ride the "
+                        "transient-tunnel retry)",
+                    ))
+                return
+            if head in np_aliases and rest in _NP_MATERIALIZERS:
+                if boundary or not node.args:
+                    return
+                a = node.args[0]
+                root = _root_name(a)
+                if root is not None:
+                    ff.sites.append(
+                        (node.lineno, "np_name", root, fname)
+                    )
+                elif isinstance(a, ast.Call):
+                    callee = dotted_name(a.func)
+                    if callee:
+                        ff.sites.append((
+                            node.lineno, "np_call",
+                            callee.rpartition(".")[2], fname,
+                        ))
+                return
+        # Scalar casts: float(x)/int(x)/bool(x)
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in _SCALAR_CASTS and len(node.args) == 1:
+            a = node.args[0]
+            root = _root_name(a)
+            if root is not None:
+                ff.sites.append(
+                    (node.lineno, "scalar_name", root, node.func.id)
+                )
+            elif isinstance(a, ast.Call):
+                callee = dotted_name(a.func)
+                if callee:
+                    ff.sites.append((
+                        node.lineno, "scalar_call",
+                        callee.rpartition(".")[2], node.func.id,
+                    ))
+            return
+        # .item() / .tolist()
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _SCALAR_METHODS and not node.args:
+            base = node.func.value
+            root = _root_name(base)
+            if root is not None:
+                ff.sites.append(
+                    (node.lineno, "scalar_name", root, node.func.attr)
+                )
+            elif isinstance(base, ast.Call):
+                callee = dotted_name(base.func)
+                if callee:
+                    ff.sites.append((
+                        node.lineno, "scalar_call",
+                        callee.rpartition(".")[2], node.func.attr,
+                    ))
+
+    # ------------------------------------------------------------- finalize
+
+    def finalize(self) -> List[Finding]:
+        files, self._files = self._files, []
+        jitted: Set[str] = set()
+        for f in files:
+            jitted.update(f.jitted)
+        if not jitted:
+            return []
+
+        findings: List[Finding] = []
+        for f in files:
+            for ff in f.fns:
+                tracked: Set[str] = set()
+                changed = True
+                while changed:
+                    changed = False
+                    for _line, targets, kind, payload in ff.assigns:
+                        hit = (kind == "call" and payload in jitted) or \
+                              (kind == "alias" and payload in tracked)
+                        if hit and not set(targets) <= tracked:
+                            tracked.update(targets)
+                            changed = True
+                # A name re-bound through a declared boundary fetch
+                # (`x = host_fetch(x)`) is host data from then on; the
+                # line-insensitive fixpoint must not keep flagging it.
+                for _line, targets, kind, payload in ff.assigns:
+                    if kind == "call" and (
+                        payload in self._BOUNDARY_NAMES
+                        or payload == "device_get"
+                    ):
+                        tracked.difference_update(targets)
+                for lineno, kind, subject, op in ff.sites:
+                    if lineno in f.suppressed:
+                        continue
+                    is_hit = subject in tracked if kind.endswith(
+                        "_name"
+                    ) else subject in jitted
+                    if not is_hit:
+                        continue
+                    if kind.startswith("scalar"):
+                        findings.append(Finding(
+                            f.path, lineno, self.name,
+                            f"`{op}` on `{subject}` (a jitted-call "
+                            "result) is an implicit device->host sync "
+                            "— one blocking tunnel round trip per "
+                            "call; batch it into the explicit result "
+                            "fetch (transport.host_fetch)",
+                        ))
+                    else:
+                        findings.append(Finding(
+                            f.path, lineno, self.name,
+                            f"`{op}` on `{subject}` (a jitted-call "
+                            "result) materializes device memory "
+                            "implicitly, outside a declared host "
+                            "boundary; fetch through transport."
+                            "host_fetch/_fetch_with_retry instead",
+                        ))
+        findings.sort(key=lambda x: (x.path, x.line))
+        return findings

@@ -39,12 +39,11 @@ full rebuild for that round.
 
 from __future__ import annotations
 
-import os
 from typing import Dict, List, Optional
 
 import numpy as np
 
-from poseidon_tpu.utils.envutil import env_int as _env_int
+from poseidon_tpu.utils.hatches import hatch_bool, hatch_int
 from poseidon_tpu.costmodel.base import (
     CostMatrices,
     CostModel,
@@ -135,7 +134,7 @@ class CostPlaneCache:
     def enabled(self) -> bool:
         return (
             getattr(self.model, "delta_plane", False)
-            and os.environ.get(ENV_GATE, "1") != "0"
+            and hatch_bool(ENV_GATE)
         )
 
     def invalidate(self, key: Optional[int] = None) -> None:
@@ -186,8 +185,8 @@ class CostPlaneCache:
             self.last_stats = self._stats(False, 0, 0, "disabled")
             self._ledger_broken(key)
             return self.model.build(ecs, machines)
-        if (E * M < _env_int("POSEIDON_COST_DELTA_MIN_CELLS", MIN_CELLS)
-                or E < _env_int("POSEIDON_COST_DELTA_MIN_ROWS", MIN_ROWS)):
+        if (E * M < hatch_int("POSEIDON_COST_DELTA_MIN_CELLS", MIN_CELLS)
+                or E < hatch_int("POSEIDON_COST_DELTA_MIN_ROWS", MIN_ROWS)):
             self.last_stats = self._stats(False, 0, 0, "small")
             self._ledger_broken(key)
             return self.model.build(ecs, machines)

@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import enum
 import logging
-import os
 import time
 from dataclasses import asdict, dataclass, field, fields
 from typing import Dict, List, Optional, Tuple
@@ -40,6 +39,7 @@ from poseidon_tpu.ops.transport import (
     sparse_adm_cells,
 )
 from poseidon_tpu.obs import trace as _trace
+from poseidon_tpu.utils.hatches import hatch_bool
 from poseidon_tpu.utils.stagetimer import stage as _stage
 
 
@@ -86,6 +86,13 @@ class RoundMetrics:
     # warm steady-state round must report 0 — PR 3's 15.2 s "solver-
     # bound" gang round was two of these hiding in solve wall time.
     fresh_compiles: int = 0
+    # Implicit device->host scalar syncs this round (check/ledger.py
+    # implicit_transfer_count diff — the TransferLedger's process
+    # counter): each is a blocking tunnel round trip invisible in every
+    # latency metric except wall time.  Must be 0; the declared
+    # boundary (transport.host_fetch) fetches explicitly and never
+    # counts.
+    implicit_transfers: int = 0
     # Bellman-Ford sweeps spent inside the kernel's global updates — the
     # dominant per-iteration op-count term (tuning signal for
     # global_update_every / bf_max).
@@ -734,6 +741,7 @@ class RoundPlanner:
                 iterations=metrics.iterations,
                 device_calls=metrics.device_calls,
                 fresh_compiles=metrics.fresh_compiles,
+                implicit_transfers=metrics.implicit_transfers,
                 repair_firings=metrics.repair_firings,
                 pruned_bands=metrics.pruned_bands,
                 pruned_width=metrics.pruned_width,
@@ -813,11 +821,15 @@ class RoundPlanner:
             self._collect_prior(view, mt)
 
         t_solve = time.perf_counter()
-        from poseidon_tpu.check.ledger import fresh_compile_count
+        from poseidon_tpu.check.ledger import (
+            fresh_compile_count,
+            implicit_transfer_count,
+        )
         from poseidon_tpu.ops.transport import device_call_count
 
         calls0 = device_call_count()
         fresh0 = fresh_compile_count()
+        transfers0 = implicit_transfer_count()
         # Assignment pipelining: a finished band's EC->task assignment
         # (pure host work, ~0.5 s of a 10k fresh wave) runs on a worker
         # thread WHILE the next band's solve occupies the device — the
@@ -833,7 +845,7 @@ class RoundPlanner:
         futures: list = []
         deferred: list = []
         pool = None
-        if os.environ.get("POSEIDON_OVERLAP_ASSIGN", "1") != "0":
+        if hatch_bool("POSEIDON_OVERLAP_ASSIGN"):
             pool = _shared_assign_pool()
 
         def on_band(idx, is_last, flows_full):
@@ -889,6 +901,7 @@ class RoundPlanner:
         # and the host ssp path is zero.
         metrics.device_calls = device_call_count() - calls0
         metrics.fresh_compiles = fresh_compile_count() - fresh0
+        metrics.implicit_transfers = implicit_transfer_count() - transfers0
         metrics.solve_seconds = time.perf_counter() - t_solve
         if metrics.gap_bound == float("inf"):
             # Even the cold retry exhausted its iteration budget: the
@@ -1682,7 +1695,7 @@ class RoundPlanner:
         any stage escalates — the caller then runs the dense path with
         the SAME warm state, exactly as if the gate had declined."""
         if (self.flow_solver != "auction" or self.solver_devices != 1
-                or os.environ.get("POSEIDON_PRUNED", "1") == "0"):
+                or not hatch_bool("POSEIDON_PRUNED")):
             return None
         from poseidon_tpu.ops import transport_pruned as tp
         from poseidon_tpu.ops.transport import derive_scale, padded_shape
@@ -1698,7 +1711,7 @@ class RoundPlanner:
         # band's scale is known.  POSEIDON_CERT_CACHE=0 escape hatch.
         ledger = self._plane_cache.take_ledger(band)
         cert = None
-        if os.environ.get("POSEIDON_CERT_CACHE", "1") != "0":
+        if hatch_bool("POSEIDON_CERT_CACHE"):
             cert = self._cert_bands.get(band)
             if cert is None:
                 cert = self._cert_bands[band] = tp.ExcludedColumnCert()
@@ -1796,8 +1809,7 @@ class RoundPlanner:
                 if (carry_box is not None
                         and stats.get("carry") is not None
                         and eff_base is cm.costs
-                        and os.environ.get(
-                            "POSEIDON_ADAPTIVE_LADDER", "1") != "0"):
+                        and hatch_bool("POSEIDON_ADAPTIVE_LADDER")):
                     # Seed the dense fallback with the last lifted
                     # full-plane state (certified eps-CS at its recorded
                     # eps) — only while NO gang rows were forbidden yet:
@@ -1866,9 +1878,9 @@ class RoundPlanner:
         E = int(ecs_b.supply.size)
         M = int(col_cap.size)
         if (not tp.row_gate_ok(
-                E, M, tp._env_int("POSEIDON_PRUNE_MIN_ROWS",
+                E, M, tp.hatch_int("POSEIDON_PRUNE_MIN_ROWS",
                                   tp.PRUNE_MIN_ROWS))
-                or M < tp._env_int("POSEIDON_PRUNE_MIN_COLS",
+                or M < tp.hatch_int("POSEIDON_PRUNE_MIN_COLS",
                                    tp.PRUNE_MIN_COLS)):
             return None
         pos = {u: j for j, u in enumerate(machine_uuids)}
@@ -1929,7 +1941,7 @@ class RoundPlanner:
         # construction and solve_transport skips the O(E*M) attempt.
         eps_is_exact = warm_eps_exact
         if (prices is None and self.flow_solver != "ssp"
-                and os.environ.get("POSEIDON_COARSE", "1") != "0"):
+                and hatch_bool("POSEIDON_COARSE")):
             # Fresh-wave coarse start: solve the machine-AGGREGATED
             # instance exactly (cheap: [E, 256] through the same
             # dispatch, sharded or not), lift its duals and primal, and
@@ -1962,8 +1974,8 @@ class RoundPlanner:
             if pre is not None:
                 if (self.solver_devices == 1
                         and not pre["certified"]
-                        and (scale is None or os.environ.get(
-                            "POSEIDON_COARSE_PINNED", "1") != "0")
+                        and (scale is None
+                             or hatch_bool("POSEIDON_COARSE_PINNED"))
                         and accel_policy("POSEIDON_COARSE_FUSED")):
                     # Pinned-scale planes (the pruned path solves
                     # reduced planes at the FULL instance's scale) run

@@ -25,18 +25,18 @@ overlap its own Perfetto lane.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Optional
 
 from poseidon_tpu.obs import trace as _trace
+from poseidon_tpu.utils.hatches import hatch_bool
 
 ENV_GATE = "POSEIDON_PIPELINE_BANDS"
 
 
 def pipelining_enabled() -> bool:
-    return os.environ.get(ENV_GATE, "1") != "0"
+    return hatch_bool(ENV_GATE)
 
 
 class _Spec:

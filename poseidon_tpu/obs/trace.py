@@ -37,6 +37,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from poseidon_tpu.utils.hatches import hatch_bool, hatch_set
+
 TRACE_ENV = "POSEIDON_TRACE"
 STAGE_ENV = "POSEIDON_STAGE_TIMERS"
 
@@ -162,10 +164,10 @@ class Tracer:
     def tracing(self) -> bool:
         if self.force is not None:
             return self.force
-        return os.environ.get(TRACE_ENV) == "1"
+        return hatch_bool(TRACE_ENV)
 
     def timing(self) -> bool:
-        return self.tracing() or os.environ.get(STAGE_ENV) == "1"
+        return self.tracing() or hatch_bool(STAGE_ENV)
 
     # ------------------------------------------------------------------ spans
 
@@ -173,13 +175,13 @@ class Tracer:
         """``parent`` (a span id) overrides the per-thread stack parent
         — used by worker-thread spans whose logical parent lives on
         another thread's stack."""
-        if self.force is None and TRACE_ENV not in os.environ \
-                and STAGE_ENV not in os.environ:
+        if self.force is None and not hatch_set(TRACE_ENV) \
+                and not hatch_set(STAGE_ENV):
             return NULL_SPAN  # the common (fully disabled) fast path
         if self.tracing():
             return Span(self, name, attrs, record=True,
                         explicit_parent=parent)
-        if os.environ.get(STAGE_ENV) == "1":
+        if hatch_bool(STAGE_ENV):
             return Span(self, name, attrs, record=False)
         return NULL_SPAN
 

@@ -54,6 +54,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from poseidon_tpu.utils.hatches import hatch_bool, hatch_int, hatch_raw
 from poseidon_tpu.utils.stagetimer import stage as _stage
 
 # Raw (cost-model) costs must fit in COST_CAP; admissibility masking uses
@@ -269,13 +270,10 @@ def iter_unroll() -> int:
     the `active` gate freezes no-op sub-iterations).
     """
     default = 4 if jax.default_backend() in ACCEL_PLATFORMS else 1
-    try:
-        # int() of an env string at TRACE time, never of a tracer (the
-        # closure pulls this helper into jit scope via _pr_phase).
-        raw = os.environ.get("POSEIDON_ITER_UNROLL", str(default))
-        return max(1, int(raw))  # posecheck: ignore[jit-purity]
-    except ValueError:
-        return default
+    # Registry read at TRACE time, never of a tracer (the closure pulls
+    # this helper into jit scope via _pr_phase); the backend-dependent
+    # default overrides the registry's.
+    return max(1, hatch_int("POSEIDON_ITER_UNROLL", default))
 
 
 def _global_update(F, Ffb, Fmt, pe, pm, pt, exc_e, exc_m, exc_t,
@@ -772,10 +770,15 @@ def _fetch_with_retry(dev_array, attempts: int = 3) -> np.ndarray:
     Only used on arrays whose computation already completed (an earlier
     fetch from the same dispatch succeeded), so a failure here is a pure
     transfer problem and re-reading the live device buffer is sound.
+
+    ``jax.device_get``, not ``np.asarray``: this is a DECLARED host
+    boundary (posecheck transfer-discipline), and explicit transfers
+    stay legal inside a ``TransferLedger``/``jax.transfer_guard``
+    budget-0 window while implicit ones fail it.
     """
     for attempt in range(attempts):
         try:
-            return np.asarray(dev_array)
+            return jax.device_get(dev_array)
         except Exception as e:  # noqa: BLE001
             if attempt == attempts - 1 or not _is_transient_backend_error(e):
                 raise
@@ -788,6 +791,22 @@ def _fetch_with_retry(dev_array, attempts: int = 3) -> np.ndarray:
             )
             time.sleep(5 * (attempt + 1))
     raise AssertionError("unreachable")
+
+
+def host_fetch(*dev_values, attempts: int = 3):
+    """THE declared device->host boundary for solver results.
+
+    One explicit ``jax.device_get`` over the whole pytree — scalars
+    included — so a wrapper pays ONE transfer slot instead of one
+    blocking sync per ``int(...)``/``np.asarray(...)`` site (each is
+    ~60-150 ms on the tunneled accelerator), with the same
+    transient-tunnel retry as ``_fetch_with_retry`` (to which this
+    delegates — ``jax.device_get`` handles pytrees, so ONE retry policy
+    serves both boundaries).  Returns the fetched values (a tuple for
+    multiple arguments, the bare value for one).
+    """
+    out = _fetch_with_retry(dev_values, attempts=attempts)
+    return out[0] if len(dev_values) == 1 else out
 
 
 @functools.partial(
@@ -949,7 +968,7 @@ def accel_policy(env_var: str) -> bool:
     ACCEL_PLATFORMS).  Used by the fused/tiled kernel gates and the
     planner's band-merge policy — one definition so a platform-list
     change cannot miss a site."""
-    env = os.environ.get(env_var, "")
+    env = hatch_raw(env_var) or ""
     if env == "0":
         return False
     if env == "1":
@@ -2054,7 +2073,7 @@ def solve_transport(
         and init_prices is not None
         and (was_warm or (eps_start is not None and eps_start <= 1))
         and not (eps_exact and eps_start is not None and eps_start > 1)
-        and os.environ.get("POSEIDON_HOST_CERT", "1") != "0"
+        and hatch_bool("POSEIDON_HOST_CERT")
     ):
         with _stage("solve.host_cert"):
             # Flow stranded on an arc the CURRENT costs forbid (gang
@@ -2107,7 +2126,7 @@ def solve_transport(
             and not on_forbidden
             and cand.gap_bound != float("inf")
             and 1 < cand.eps_certified
-            and os.environ.get("POSEIDON_ADAPTIVE_LADDER", "1") != "0"
+            and hatch_bool("POSEIDON_ADAPTIVE_LADDER")
         ):
             # Adaptive ladder entry: the rejected certificate candidate
             # already priced the start EXACTLY (its eps_certified is the
@@ -2169,7 +2188,7 @@ def solve_transport(
                 )
                 # Fetch INSIDE the guard: dispatch is async, so execution-
                 # time errors surface here, not at the call above.
-                small_h = np.asarray(small_d)
+                small_h = _fetch_with_retry(small_d, attempts=1)
             return F_d, small_h
         except Exception as e:  # noqa: BLE001 - availability over speed
             import logging
@@ -2201,7 +2220,7 @@ def solve_transport(
                 )
                 # Fetch inside the retry: async dispatch surfaces
                 # execution/transfer errors at the first result read.
-                out = (F_d, np.asarray(small_d))
+                out = (F_d, _fetch_with_retry(small_d, attempts=1))
         except Exception as e:  # noqa: BLE001
             # The lax path has no fallback below it: ride out transient
             # tunnel-side outages (remote-compile restarts) instead of

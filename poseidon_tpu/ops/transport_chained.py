@@ -52,6 +52,7 @@ from poseidon_tpu.ops.transport import (
     NUM_PHASES,
     UNBOUNDED_ARC_CAP,
     TransportSolution,
+    _fetch_with_retry,
     _host_finalize,
     _host_validate,
     _Telemetry,
@@ -340,9 +341,9 @@ def chain_gate() -> bool:
     host rebuild against that residual; tools/tpu_session.sh step 4b
     A/Bs both paths live, and the default flips only with hardware
     evidence — the scored artifact must not gamble on it."""
-    import os
+    from poseidon_tpu.utils.hatches import hatch_bool
 
-    return os.environ.get("POSEIDON_CHAINED") == "1"
+    return hatch_bool("POSEIDON_CHAINED")
 
 
 def solve_wave_chained(
@@ -591,8 +592,8 @@ def solve_wave_chained(
             costsB_d.copy_to_host_async()
         except (AttributeError, RuntimeError):
             pass
-        small = np.asarray(small_d)
-        flows = np.asarray(flows_d)
+        small = _fetch_with_retry(small_d, attempts=1)
+        flows = _fetch_with_retry(flows_d, attempts=1)
     except Exception as e:  # noqa: BLE001 - decline, never fail the round
         _decline_on_backend_error(e)
         return None
@@ -605,7 +606,7 @@ def solve_wave_chained(
         # makes the caller discard it (on_band_reset).
         early(flows[:E1, :M])
     try:
-        costs2 = np.asarray(costsB_d)[:E2, :M]
+        costs2 = _fetch_with_retry(costsB_d, attempts=1)[:E2, :M]
     except Exception as e:  # noqa: BLE001 - transfer flake: decline
         _decline_on_backend_error(e)
         return None

@@ -434,7 +434,9 @@ def solve_transport_coarse_fused(
         # One fetch decides the decline before the (large) flow fetch —
         # and it is the async sync point, so execution-time errors
         # surface INSIDE this guard.
-        small = np.asarray(small_dev)
+        from poseidon_tpu.ops.transport import _fetch_with_retry
+
+        small = _fetch_with_retry(small_dev, attempts=1)
     except Exception as e:  # noqa: BLE001
         # A tunnel-side outage (remote-compile restart) must decline to
         # the ordinary two-dispatch path, not kill the scheduler round;

@@ -35,13 +35,12 @@ caller's closure.
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
-from poseidon_tpu.utils.envutil import env_int as _env_int
+from poseidon_tpu.utils.hatches import hatch_bool, hatch_int
 from poseidon_tpu.ops.transport import (
     INF_COST,
     TransportSolution,
@@ -96,11 +95,11 @@ def row_gate_ok(E: int, M: int, min_rows: int) -> bool:
     can never disagree on which planes prune."""
     if E >= min_rows:
         return True
-    if os.environ.get("POSEIDON_PRUNE_WAVE", "1") == "0":
+    if not hatch_bool("POSEIDON_PRUNE_WAVE"):
         return False
     return (
-        E >= _env_int("POSEIDON_PRUNE_WAVE_MIN_ROWS", PRUNE_WAVE_MIN_ROWS)
-        and M >= _env_int("POSEIDON_PRUNE_WAVE_MIN_COLS",
+        E >= hatch_int("POSEIDON_PRUNE_WAVE_MIN_ROWS", PRUNE_WAVE_MIN_ROWS)
+        and M >= hatch_int("POSEIDON_PRUNE_WAVE_MIN_COLS",
                           PRUNE_WAVE_MIN_COLS)
     )
 
@@ -137,9 +136,9 @@ def plan_shortlist(
     # Env tunables apply only when the caller left the knob unset —
     # explicit arguments always win over ambient configuration.
     if min_rows is None:
-        min_rows = _env_int("POSEIDON_PRUNE_MIN_ROWS", PRUNE_MIN_ROWS)
+        min_rows = hatch_int("POSEIDON_PRUNE_MIN_ROWS", PRUNE_MIN_ROWS)
     if min_cols is None:
-        min_cols = _env_int("POSEIDON_PRUNE_MIN_COLS", PRUNE_MIN_COLS)
+        min_cols = hatch_int("POSEIDON_PRUNE_MIN_COLS", PRUNE_MIN_COLS)
     dense_factor = (PRUNE_DENSE_FACTOR if dense_factor is None
                     else dense_factor)
     slack = PRUNE_SLACK if slack is None else slack

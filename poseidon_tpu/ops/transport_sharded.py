@@ -35,6 +35,7 @@ from poseidon_tpu.ops.transport import (
     _host_finalize,
     _host_validate,
     _solve_device,
+    host_fetch,
 )
 
 MACHINE_AXIS = "machines"
@@ -195,19 +196,27 @@ def solve_transport_sharded(
         max_iter=max_iter_per_phase, scale=int(scale),
     )
 
-    flows = np.asarray(flows)[:E, :M]
-    unsched = np.asarray(unsched)[:E]
-    prices_full = np.asarray(prices)
+    # ONE explicit boundary fetch for every result — arrays AND the
+    # telemetry scalars.  The previous per-value `np.asarray`/`int()`
+    # conversions were each an implicit device->host sync (a blocking
+    # tunnel round trip apiece on the production accelerator, and a
+    # transfer-guard violation under TransferLedger budget-0 windows).
+    (flows, unsched, prices_full, iters, bf, clean,
+     phase_iters) = host_fetch(
+        flows, unsched, prices, iters, bf, clean, phase_iters,
+    )
+    flows = flows[:E, :M]
+    unsched = unsched[:E]
     prices_out = np.concatenate(
         [prices_full[:E], prices_full[e_pad : e_pad + M],
          prices_full[e_pad + m_pad :]]
     )
     sol = _host_finalize(
-        flows, unsched, prices_out, iters,
+        flows, unsched, prices_out, int(iters),
         costs=costs, supply=supply, capacity=capacity,
-        unsched_cost=unsched_cost, scale=scale, clean=clean,
+        unsched_cost=unsched_cost, scale=scale, clean=bool(clean),
         arc_capacity=arc_capacity, bf_sweeps=int(bf),
-        phase_iters=tuple(int(x) for x in np.asarray(phase_iters)),
+        phase_iters=tuple(int(x) for x in phase_iters),
     )
     from poseidon_tpu.ops.transport import ladder_entry_phase
 

@@ -11,7 +11,6 @@ cost objective — the driver for the 10k-node/100k-pod config 5.
 from __future__ import annotations
 
 import heapq
-import os
 import sys
 import time
 from dataclasses import dataclass, field
@@ -23,6 +22,7 @@ from poseidon_tpu.costmodel import get_cost_model
 from poseidon_tpu.graph.instance import RoundPlanner
 from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
 from poseidon_tpu.replay.trace import TraceEvent
+from poseidon_tpu.utils.hatches import hatch_flag
 from poseidon_tpu.utils.ids import generate_uuid, task_uid
 
 
@@ -188,7 +188,7 @@ class ReplayDriver:
                 shapes = self.planner.precompile(max_ecs=256)
                 report.precompile_s = time.perf_counter() - t0
                 report.precompile_shapes = shapes
-                if os.environ.get("POSEIDON_REPLAY_PROGRESS"):
+                if hatch_flag("POSEIDON_REPLAY_PROGRESS"):
                     print(
                         f"# replay precompile: {shapes} shapes in "
                         f"{report.precompile_s:.1f}s",
@@ -199,7 +199,7 @@ class ReplayDriver:
             report.rounds += 1
             report.round_seconds.append(metrics.total_seconds)
             report.solve_seconds.append(metrics.solve_seconds)
-            if os.environ.get("POSEIDON_REPLAY_PROGRESS"):
+            if hatch_flag("POSEIDON_REPLAY_PROGRESS"):
                 # Per-round breadcrumbs for the bench harness: the
                 # round-5 TPU trace child burned its whole budget with
                 # zero observable output, leaving 'where did 3000 s go'

@@ -125,7 +125,9 @@ class FirmamentServicer:
             if not self.config.precompile or self._precompiled:
                 return 0
             self._precompiled = True
-            cache_dir = os.environ.get("POSEIDON_COMPILE_CACHE_DIR")
+            from poseidon_tpu.utils.hatches import hatch_str
+
+            cache_dir = hatch_str("POSEIDON_COMPILE_CACHE_DIR")
             if cache_dir:
                 from poseidon_tpu.utils.envutil import (
                     enable_compilation_cache,
@@ -363,7 +365,7 @@ def main(argv=None) -> None:
         format="%(asctime)s %(levelname).1s %(name)s] %(message)s",
     )
     from poseidon_tpu.utils.envutil import (
-        DEVICE_LOCK_PATH,
+        device_lock_path,
         enable_compilation_cache,
         serialize_device_access,
     )
@@ -380,7 +382,7 @@ def main(argv=None) -> None:
     # shared file is unopenable.)
     if not serialize_device_access():
         log.warning(
-            "device lock %s busy; waiting indefinitely", DEVICE_LOCK_PATH
+            "device lock %s busy; waiting indefinitely", device_lock_path()
         )
         serialize_device_access(timeout=None)
     cfg = load_config(FirmamentTPUConfig, argv=argv)

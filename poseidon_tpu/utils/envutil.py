@@ -17,16 +17,6 @@ import time
 from typing import Optional
 
 
-def env_int(name: str, default: int) -> int:
-    """Integer knob from the environment; unparseable values fall back
-    to the default (shared by the POSEIDON_* tuning knobs — one parser,
-    one set of semantics)."""
-    try:
-        return int(os.environ.get(name, default))
-    except ValueError:
-        return default
-
-
 def clean_cpu_env(root: str, n_devices: Optional[int] = None) -> dict:
     """Environment for a clean-CPU child process.
 
@@ -128,9 +118,14 @@ def enable_compilation_cache(path: Optional[str] = None) -> None:
 # OS drops the lock on ANY exit — including SIGKILL — so a dead holder
 # can never leave the lock stuck.
 
-DEVICE_LOCK_PATH = os.environ.get(
-    "POSEIDON_DEVICE_LOCK", "/tmp/poseidon_tpu_device.lock"
-)
+def device_lock_path() -> str:
+    """Lock-file path ($POSEIDON_DEVICE_LOCK), read at call time so
+    tests and multi-tenant wrappers can redirect it per-acquire."""
+    from poseidon_tpu.utils.hatches import hatch_str
+
+    return hatch_str("POSEIDON_DEVICE_LOCK")
+
+
 _device_lock_fd: Optional[int] = None
 
 
@@ -165,7 +160,9 @@ def serialize_device_access(timeout=_ENV_TIMEOUT) -> bool:
     """
     global _device_lock_fd
     if timeout is _ENV_TIMEOUT:
-        timeout = float(os.environ.get("POSEIDON_DEVICE_LOCK_TIMEOUT", "600"))
+        from poseidon_tpu.utils.hatches import hatch_float
+
+        timeout = hatch_float("POSEIDON_DEVICE_LOCK_TIMEOUT")
     if not _may_touch_accelerator():
         return True
     if _device_lock_fd is not None:
@@ -174,12 +171,13 @@ def serialize_device_access(timeout=_ENV_TIMEOUT) -> bool:
         import fcntl
     except ImportError:  # non-POSIX: nothing to serialize with
         return True
+    lock_path = device_lock_path()
     try:
-        fd = os.open(DEVICE_LOCK_PATH, os.O_CREAT | os.O_RDWR, 0o666)
+        fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o666)
     except OSError:
         try:
             fd = os.open(
-                f"{DEVICE_LOCK_PATH}.{os.getuid()}",
+                f"{lock_path}.{os.getuid()}",
                 os.O_CREAT | os.O_RDWR, 0o600,
             )
         except OSError:

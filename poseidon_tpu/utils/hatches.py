@@ -1,0 +1,322 @@
+"""Central registry of every ``POSEIDON_*`` environment escape hatch.
+
+Before this module the ~37 ``POSEIDON_*`` knobs lived as ad-hoc
+``os.environ.get`` calls scattered over 15 files, with three different
+boolean conventions (``!= "0"`` default-on gates, ``== "1"`` opt-ins,
+truthy "flag set at all" markers), no single place that said what a
+hatch does or what its default is, and nothing stopping a doc comment
+from drifting from the code (the ``_try_chained_wave`` docstring said
+"default ON" for a flag the code treated as opt-in — PR 2's fix, but
+nothing kept it fixed).  The registry is the single source of truth:
+
+- every hatch is declared ONCE here with its name, kind, default, and a
+  one-line effect string (the generated table in ``docs/HATCHES.md``
+  renders straight from these declarations);
+- call sites read through the typed call-time accessors below
+  (``hatch_bool`` / ``hatch_int`` / ...), which raise ``KeyError`` on an
+  unregistered name — a typo'd hatch name fails loudly instead of
+  silently reading the default forever;
+- the static rule ``posecheck hatch-registry``
+  (``poseidon_tpu/check/hatch_registry.py``) flags direct
+  ``os.environ`` reads of ``POSEIDON_*`` names outside this module,
+  accessor reads of undeclared names, and declared hatches nothing
+  reads (dead flags).
+
+Accessors read the environment at CALL time, never at import time — the
+same discipline the determinism rule's import-time-env sub-check
+enforces (a value pinned at first import silently ignores everything
+tests and bench runs export later).
+
+``python -m poseidon_tpu.utils.hatches`` prints the markdown table
+committed as ``docs/HATCHES.md`` (drift-gated by
+``tests/test_check_selfcheck.py``).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+# Hatch kinds and their read conventions:
+#   bool_on   default ON:  any value other than "0" enables
+#   bool_off  default OFF: only exactly "1" enables
+#   flag      OFF unless set to any non-empty string
+#   tristate  "1" forces on, "0" forces off, unset defers to the
+#             backend policy (transport.accel_policy)
+#   int/float numeric knob; unparseable values fall back to the default
+#   str       free-form string (paths)
+#   external  consumed outside Python (Makefile/shell); exempt from the
+#             dead-flag check
+_KINDS = (
+    "bool_on", "bool_off", "flag", "tristate", "int", "float", "str",
+    "external",
+)
+
+
+@dataclass(frozen=True)
+class Hatch:
+    name: str
+    kind: str
+    default: str  # string form; "" means unset/backend-dependent
+    doc: str      # one-line effect, rendered into docs/HATCHES.md
+
+    def __post_init__(self) -> None:
+        if not self.name.startswith("POSEIDON_"):
+            raise ValueError(f"hatch {self.name!r} must be POSEIDON_*")
+        if self.kind not in _KINDS:
+            raise ValueError(f"hatch {self.name}: unknown kind {self.kind!r}")
+        if not self.doc.strip():
+            raise ValueError(f"hatch {self.name}: doc line is required")
+
+
+HATCHES: Tuple[Hatch, ...] = (
+    # ------------------------------------------------------- solver kernels
+    Hatch("POSEIDON_ITER_UNROLL", "int", "",
+          "Main-loop iterations per lax.while_loop step (default 4 on "
+          "accelerators, 1 on CPU; see transport.iter_unroll)"),
+    Hatch("POSEIDON_HOST_CERT", "bool_on", "1",
+          "Pre-dispatch host certificate: return a warm start that "
+          "certifies exactly without dispatching the device kernel"),
+    Hatch("POSEIDON_ADAPTIVE_LADDER", "bool_on", "1",
+          "Adaptive epsilon-ladder entry at a rejected host-cert "
+          "candidate's certified eps, plus escalation warm-carry"),
+    Hatch("POSEIDON_ADAPTIVE_BF", "tristate", "",
+          "Excess-decay-adaptive global-update cadence inside the "
+          "kernel (accelerator default ON; CPU measured a wash)"),
+    Hatch("POSEIDON_RESIDENT", "tristate", "",
+          "Device-resident operand cache: ship only changed columns of "
+          "the [3,E,M] operand buffer between solves"),
+    Hatch("POSEIDON_FUSED", "tristate", "",
+          "Fused Pallas iteration kernel (accelerator default ON; "
+          "interpret mode on CPU)"),
+    Hatch("POSEIDON_TILED", "tristate", "",
+          "Tiled Pallas iteration kernel (accelerator default ON, "
+          "superseded by fused where both gate in)"),
+    Hatch("POSEIDON_COARSE", "bool_on", "1",
+          "Fresh-wave coarse warm start: solve the machine-aggregated "
+          "instance and lift its duals"),
+    Hatch("POSEIDON_COARSE_FUSED", "tristate", "",
+          "One-program fused coarse pipeline (aggregate -> coarse "
+          "ladder -> lift -> certify -> full ladder) on accelerators"),
+    Hatch("POSEIDON_COARSE_PINNED", "bool_on", "1",
+          "Allow the fused coarse start on pinned-scale (reduced) "
+          "planes; 0 restores the `scale is None` gate"),
+    Hatch("POSEIDON_CHAINED", "bool_off", "0",
+          "Chained two-band wave device program (A/B path, default "
+          "OFF; flips only with live hardware evidence)"),
+    # --------------------------------------------------------- pruned planes
+    Hatch("POSEIDON_PRUNED", "bool_on", "1",
+          "Pruned-plane solve path: per-row shortlists + price-out "
+          "loop + full-plane certificate"),
+    Hatch("POSEIDON_PRUNE_MIN_ROWS", "int", "192",
+          "Classic row gate: minimum EC rows before a plane prunes"),
+    Hatch("POSEIDON_PRUNE_MIN_COLS", "int", "4096",
+          "Minimum machine columns before a plane prunes"),
+    Hatch("POSEIDON_PRUNE_WAVE", "bool_on", "1",
+          "Wave-shaped secondary prune gate (few rows x very wide); 0 "
+          "restores the classic row gate exactly"),
+    Hatch("POSEIDON_PRUNE_WAVE_MIN_ROWS", "int", "16",
+          "Wave gate: minimum EC rows"),
+    Hatch("POSEIDON_PRUNE_WAVE_MIN_COLS", "int", "8192",
+          "Wave gate: minimum machine columns"),
+    Hatch("POSEIDON_CERT_CACHE", "bool_on", "1",
+          "Reduced-plane excluded-column certificate cache fed from "
+          "the delta-plane ledger"),
+    # ----------------------------------------------------- incremental round
+    Hatch("POSEIDON_COST_DELTA", "bool_on", "1",
+          "Delta-maintained cost planes (costmodel/delta.py); 0 forces "
+          "full rebuilds"),
+    Hatch("POSEIDON_COST_DELTA_MIN_CELLS", "int", "2048",
+          "Minimum E*M cells before delta maintenance pays"),
+    Hatch("POSEIDON_COST_DELTA_MIN_ROWS", "int", "8",
+          "Minimum EC rows before delta maintenance pays"),
+    Hatch("POSEIDON_PIPELINE_BANDS", "bool_on", "1",
+          "Cross-band cost-build pipelining on a worker thread"),
+    Hatch("POSEIDON_OVERLAP_ASSIGN", "bool_on", "1",
+          "Overlap finished bands' EC->task assignment with the next "
+          "band's solve"),
+    Hatch("POSEIDON_MERGE_BANDS", "tristate", "",
+          "Merge compatible bands into one device program "
+          "(accelerator dispatch-count policy)"),
+    # -------------------------------------------------------- observability
+    Hatch("POSEIDON_TRACE", "bool_off", "0",
+          "Record hierarchical spans (Perfetto-exportable; "
+          "obs/trace.py)"),
+    Hatch("POSEIDON_STAGE_TIMERS", "bool_off", "0",
+          "Aggregate per-stage wall timings without span recording"),
+    Hatch("POSEIDON_REPLAY_PROGRESS", "flag", "",
+          "Per-round progress breadcrumbs on stderr during replay"),
+    # ------------------------------------------------------- process plumbing
+    Hatch("POSEIDON_COMPILE_CACHE_DIR", "str", "",
+          "Persistent XLA compile cache directory for "
+          "ensure_precompiled (service restarts skip the compile "
+          "storm)"),
+    Hatch("POSEIDON_DEVICE_LOCK", "str", "/tmp/poseidon_tpu_device.lock",
+          "Path of the host-wide exclusive accelerator flock"),
+    Hatch("POSEIDON_DEVICE_LOCK_TIMEOUT", "float", "600",
+          "Seconds to wait for the accelerator lock before declaring "
+          "BUSY and falling back to CPU"),
+    # ----------------------------------------------------------------- bench
+    Hatch("POSEIDON_BENCH_RUNG_TIMEOUT", "int", "1800",
+          "Per-rung bench child budget (seconds)"),
+    Hatch("POSEIDON_BENCH_FEATURES_TIMEOUT", "int", "1200",
+          "Features-config bench child budget (seconds)"),
+    Hatch("POSEIDON_BENCH_TERM_GRACE", "int", "300",
+          "Grace between SIGTERM and SIGKILL for a timed-out bench "
+          "child (must cover one worst-case device program)"),
+    Hatch("POSEIDON_BENCH_NO_PROBE", "flag", "",
+          "Skip the backend probe (verdict already latched by the "
+          "parent, or the operator knows the backend)"),
+    Hatch("POSEIDON_BENCH_FUSED_SMOKE", "flag", "",
+          "Shrink tools/bench_fused.py to smoke scale"),
+    Hatch("POSEIDON_ENTRY_NO_PROBE", "flag", "",
+          "Entry-point probe latch (set by __graft_entry__ after its "
+          "single backend probe)"),
+    # -------------------------------------------------------------- external
+    Hatch("POSEIDON_PERF_GATE", "external", "",
+          "Set to `warn` to downgrade `make perf-gate` to warn-only on "
+          "known-noisy machines (consumed by the Makefile)"),
+)
+
+_BY_NAME = {h.name: h for h in HATCHES}
+if len(_BY_NAME) != len(HATCHES):
+    raise AssertionError("duplicate hatch declaration")
+
+
+def hatch(name: str) -> Hatch:
+    """The declaration for ``name``; KeyError on unregistered names."""
+    try:
+        return _BY_NAME[name]
+    except KeyError:
+        raise KeyError(
+            f"unregistered hatch {name!r}: declare it in "
+            "poseidon_tpu/utils/hatches.py (posecheck hatch-registry "
+            "enforces this statically)"
+        ) from None
+
+
+def hatch_raw(name: str) -> Optional[str]:
+    """The raw environment value (None when unset), read at call time."""
+    hatch(name)
+    return os.environ.get(name)
+
+
+def hatch_set(name: str) -> bool:
+    """True iff the hatch is present in the environment at all (the
+    tracer's fully-disabled fast path needs exactly this)."""
+    hatch(name)
+    return name in os.environ
+
+
+def hatch_bool(name: str) -> bool:
+    """Boolean gate with the declared default convention: ``bool_on``
+    hatches disable only on exactly "0"; ``bool_off`` hatches enable
+    only on exactly "1" (both faithful to the pre-registry reads)."""
+    h = hatch(name)
+    raw = os.environ.get(name)
+    if h.kind == "bool_on":
+        return (raw if raw is not None else h.default) != "0"
+    if h.kind == "bool_off":
+        return (raw if raw is not None else h.default) == "1"
+    raise TypeError(f"hatch {name} is {h.kind}, not a bool gate")
+
+
+def hatch_flag(name: str) -> bool:
+    """True iff set to any non-empty string (latch-style markers)."""
+    h = hatch(name)
+    if h.kind != "flag":
+        raise TypeError(f"hatch {name} is {h.kind}, not a flag")
+    return bool(os.environ.get(name))
+
+
+def _numeric_fallback(h: Hatch, default, conv):
+    if default is not None:
+        return default
+    if h.default == "":
+        # A hatch with a computed (backend-dependent) default: the
+        # caller must supply it.  A loud programming error beats a
+        # silent wrong constant.
+        raise TypeError(
+            f"hatch {h.name} declares no numeric default; pass default="
+        )
+    return conv(h.default)
+
+
+def hatch_int(name: str, default: Optional[int] = None) -> int:
+    """Integer knob; unparseable values fall back to the default (the
+    former ``envutil.env_int`` semantics — an operator typo must not
+    crash a solve).  ``default`` overrides the declared default for
+    call sites whose baseline is computed (backend-dependent)."""
+    h = hatch(name)
+    raw = os.environ.get(name)
+    if raw is not None:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    return _numeric_fallback(h, default, int)
+
+
+def hatch_float(name: str, default: Optional[float] = None) -> float:
+    h = hatch(name)
+    raw = os.environ.get(name)
+    if raw is not None:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return _numeric_fallback(h, default, float)
+
+
+def hatch_str(name: str) -> str:
+    """String knob (paths); the declared default when unset/empty."""
+    h = hatch(name)
+    return os.environ.get(name) or h.default
+
+
+# ------------------------------------------------------------- doc rendering
+
+_KIND_LABEL = {
+    "bool_on": "bool (default on; `0` disables)",
+    "bool_off": "bool (default off; `1` enables)",
+    "flag": "flag (any non-empty value)",
+    "tristate": "tristate (`1` on / `0` off / unset = backend policy)",
+    "int": "int",
+    "float": "float",
+    "str": "string",
+    "external": "external (Makefile/shell)",
+}
+
+
+def markdown_table() -> str:
+    """The generated hatch table committed as ``docs/HATCHES.md``."""
+    lines = [
+        "# POSEIDON_* escape hatches",
+        "",
+        "GENERATED by `python -m poseidon_tpu.utils.hatches` from the",
+        "registry in `poseidon_tpu/utils/hatches.py` — edit there, then",
+        "regenerate:",
+        "",
+        "```bash",
+        "python -m poseidon_tpu.utils.hatches > docs/HATCHES.md",
+        "```",
+        "",
+        "Every hatch is read at call time through the registry",
+        "accessors; direct `os.environ` reads of `POSEIDON_*` names are",
+        "a lint failure (`posecheck hatch-registry`, docs/CHECKS.md).",
+        "",
+        "| hatch | kind | default | effect |",
+        "| --- | --- | --- | --- |",
+    ]
+    for h in HATCHES:
+        default = h.default if h.default != "" else "(unset)"
+        lines.append(
+            f"| `{h.name}` | {_KIND_LABEL[h.kind]} | `{default}` | "
+            f"{h.doc} |"
+        )
+    return "\n".join(lines) + "\n"
+
+
+if __name__ == "__main__":
+    print(markdown_table(), end="")

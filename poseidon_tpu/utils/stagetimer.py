@@ -25,14 +25,14 @@ winning optimization differs for each.
 
 from __future__ import annotations
 
-import os
 from typing import Dict, Tuple
 
 from poseidon_tpu.obs import trace as _trace
+from poseidon_tpu.utils.hatches import hatch_bool
 
 
 def enabled() -> bool:
-    return os.environ.get("POSEIDON_STAGE_TIMERS") == "1"
+    return hatch_bool("POSEIDON_STAGE_TIMERS")
 
 
 def stage(name: str):

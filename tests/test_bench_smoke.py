@@ -109,7 +109,10 @@ def test_wave_rung_smoke_warm_rounds_compile_free():
     import numpy as np
 
     import bench
-    from poseidon_tpu.check.ledger import CompileLedger
+    from poseidon_tpu.check.ledger import (
+        CompileLedger,
+        TransferLedger,
+    )
     from poseidon_tpu.costmodel import get_cost_model
     from poseidon_tpu.graph.instance import RoundPlanner
 
@@ -124,7 +127,8 @@ def test_wave_rung_smoke_warm_rounds_compile_free():
     for uid in list(state.tasks.keys()):
         state.task_removed(uid)
     bench.submit_population(state, 2000, 16, seed=1)
-    with CompileLedger(budget=0, label="warm wave round"):
+    with CompileLedger(budget=0, label="warm wave round"), \
+            TransferLedger(budget=0, label="warm wave round"):
         _, m_wave = planner.schedule_round()
     assert m_wave.placed > 0
     assert m_wave.converged
@@ -141,6 +145,12 @@ def test_wave_rung_smoke_warm_rounds_compile_free():
 
     rng = np.random.default_rng(5)
     bench.churn_step(state, rng)
-    with CompileLedger(budget=0, label="warm churn round"):
+    with CompileLedger(budget=0, label="warm churn round"), \
+            TransferLedger(budget=0, label="warm churn round"):
         _, m_churn = planner.schedule_round()
     assert m_churn.converged
+    # The warm rounds above just PROVED budget 0; the telemetry field
+    # must agree and ride the wire format.
+    assert m_wave.implicit_transfers == 0
+    assert m_churn.implicit_transfers == 0
+    assert "implicit_transfers" in m_churn.to_dict()

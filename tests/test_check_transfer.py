@@ -1,0 +1,205 @@
+"""Runtime tests for the transfer side of the check suite.
+
+The static rule (``transfer-discipline``) names the *patterns*; the
+``TransferLedger`` catches the *events*.  The seeded test here proves
+the pairing end to end: one deliberate hot-path ``.item()`` trips the
+static rule on the source AND the runtime ledger on execution —
+the contract ``tests/test_check_ledger.py`` established for the
+retrace-guard/CompileLedger pair.
+
+Also here: the randomized use-after-donation parity suite (donating
+kernels must be bit-identical to their non-donating oracles, and the
+rebind idiom must keep warm state correct across calls), and the
+``RoundMetrics.implicit_transfers`` wire-format/exporter ride.
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poseidon_tpu.check import check_file
+from poseidon_tpu.check.ledger import (
+    TransferBudgetExceeded,
+    TransferLedger,
+    implicit_transfer_count,
+)
+from poseidon_tpu.check.transfer_discipline import TransferDisciplineRule
+
+SEEDED_HOT_PATH = textwrap.dedent(
+    """
+    import jax
+    import numpy as np
+
+
+    @jax.jit
+    def _step(x):
+        return x * 2, x.sum()
+
+
+    def hot_round(x):
+        F, s = _step(x)
+        total = s.item()  # the deliberate implicit sync
+        return F, total
+    """
+)
+
+
+def test_seeded_item_trips_static_rule_and_ledger(tmp_path):
+    """The same deliberate ``.item()`` on a jitted result fails BOTH
+    gates: the static scan flags the source line, and executing it
+    under ``TransferLedger(budget=0)`` raises with the call site."""
+    mod = tmp_path / "seeded_hot_path.py"
+    mod.write_text(SEEDED_HOT_PATH)
+    rule = TransferDisciplineRule()
+    pre = check_file(mod, [rule], forced=True, root=tmp_path)
+    found = pre + rule.finalize()
+    assert len(found) == 1
+    assert "item" in found[0].message
+    assert "implicit device->host sync" in found[0].message
+
+    # Runtime half: execute the very same module under budget 0.
+    ns: dict = {}
+    exec(compile(SEEDED_HOT_PATH, str(mod), "exec"), ns)
+    x = jnp.arange(8)
+    ns["hot_round"](x)  # warm (compile outside the window)
+    with pytest.raises(TransferBudgetExceeded) as e:
+        with TransferLedger(budget=0, label="seeded hot round"):
+            ns["hot_round"](x)
+    assert "item()" in str(e.value)
+    assert "seeded hot round" in str(e.value)
+
+
+def test_ledger_telemetry_and_budget_modes():
+    x = jnp.arange(6)
+    x.sum().block_until_ready()
+    c0 = implicit_transfer_count()
+    with TransferLedger(budget=None, label="telemetry") as tl:
+        float(x.sum())
+        int(x.max())
+        bool(x.sum() > 0)
+    assert tl.implicit_transfers == 3
+    assert implicit_transfer_count() - c0 == 3
+    # Offenders carry method + call-site attribution.
+    assert any("__float__" in o for o in tl.offenders)
+    assert all("test_check_transfer.py" in o for o in tl.offenders)
+
+    # Explicit fetches are the sanctioned boundary: never counted.
+    with TransferLedger(budget=0, label="clean") as tl2:
+        host = jax.device_get(x)
+        _ = float(host.sum())  # numpy scalar: host data, no sync
+    assert tl2.implicit_transfers == 0
+
+    # A body exception is never masked by the budget report.
+    with pytest.raises(ValueError):
+        with TransferLedger(budget=0, label="masking"):
+            float(x.sum())
+            raise ValueError("real failure")
+
+
+def test_ledger_nests_with_compile_ledger():
+    from poseidon_tpu.check.ledger import CompileLedger
+
+    x = jnp.arange(4)
+    x.sum().block_until_ready()
+    with CompileLedger(budget=0, label="warm"), \
+            TransferLedger(budget=0, label="warm"):
+        y = jax.device_get(x.sum())
+    assert int(y) == 6
+
+
+def test_host_fetch_is_ledger_clean():
+    """transport.host_fetch — the declared boundary — fetches arrays
+    AND scalars in one explicit transfer that budget-0 windows admit."""
+    from poseidon_tpu.ops.transport import host_fetch
+
+    F = jnp.arange(12).reshape(3, 4)
+    s = F.sum()
+    with TransferLedger(budget=0, label="boundary fetch") as tl:
+        F_h, s_h = host_fetch(F, s)
+        total = int(s_h)  # numpy now: free
+    assert tl.implicit_transfers == 0
+    assert total == 66
+    assert isinstance(F_h, np.ndarray)
+    # Single-argument form returns the bare value.
+    assert host_fetch(s).item() == 66
+
+
+# ----------------------------------------------------------- donation
+
+
+def test_use_after_donation_parity_randomized():
+    """The resident-cache donating kernels against numpy oracles, with
+    randomized shapes/payloads: results bit-identical, and the rebind
+    idiom (never touching the donated handle again) keeps the device
+    state correct across a chain of donating calls."""
+    from poseidon_tpu.ops.transport import (
+        _resident_scatter_cols,
+        _resident_set_flows,
+    )
+
+    rng = np.random.default_rng(11)
+    for _ in range(8):
+        E = int(rng.integers(2, 9))
+        M = int(rng.integers(4, 17))
+        k = int(rng.integers(1, M + 1))
+        big = rng.integers(-1000, 1000, size=(3, E, M)).astype(np.int32)
+        idx = rng.choice(M, size=k, replace=False).astype(np.int32)
+        payload = rng.integers(-1000, 1000, size=(3, E, k)).astype(
+            np.int32
+        )
+        flows = rng.integers(0, 50, size=(E, M)).astype(np.int32)
+
+        # Oracle: plain numpy column scatter, then plane-2 overwrite.
+        oracle = big.copy()
+        oracle[:, :, idx] = payload
+        oracle2 = oracle.copy()
+        oracle2[2] = flows
+
+        dev = jnp.asarray(big)
+        dev = _resident_scatter_cols(
+            dev, jnp.asarray(idx), jnp.asarray(payload)
+        )
+        np.testing.assert_array_equal(np.asarray(dev), oracle)
+        dev = _resident_set_flows(dev, jnp.asarray(flows))
+        np.testing.assert_array_equal(np.asarray(dev), oracle2)
+
+
+def test_donated_buffer_is_consumed():
+    """After a donating call, the donated handle is dead where the
+    backend supports donation; the rebind idiom the static rule's
+    use-after-donation check enforces is what makes this safe."""
+    from poseidon_tpu.ops.transport import _resident_set_flows
+
+    big = jnp.zeros((3, 2, 4), jnp.int32)
+    flows = jnp.ones((2, 4), jnp.int32)
+    out = _resident_set_flows(big, flows)
+    assert np.asarray(out)[2].sum() == 8
+    if big.is_deleted():
+        # Donation honored (accelerators; some CPU jaxlibs too): any
+        # read of the donated operand must now fail loudly.
+        with pytest.raises(RuntimeError):
+            np.asarray(big)
+
+
+# ----------------------------------------------------- metrics plumbing
+
+
+def test_implicit_transfers_rides_wire_format_and_metrics():
+    from poseidon_tpu.graph.instance import RoundMetrics
+    from poseidon_tpu.obs.metrics import Registry, observe_round
+
+    m = RoundMetrics(round_index=3, implicit_transfers=2)
+    d = m.to_dict()
+    assert d["implicit_transfers"] == 2
+    back = RoundMetrics.from_dict(d)
+    assert back.implicit_transfers == 2
+
+    reg = Registry()
+    observe_round(m, reg)
+    text = reg.expose()
+    assert "poseidon_round_implicit_transfers 2" in text

@@ -113,7 +113,7 @@ def test_serialize_device_access_excludes_second_process(
 
     lock = tmp_path / "device.lock"
     monkeypatch.setenv("JAX_PLATFORMS", "")  # accelerator-capable
-    monkeypatch.setattr(envutil, "DEVICE_LOCK_PATH", str(lock))
+    monkeypatch.setenv("POSEIDON_DEVICE_LOCK", str(lock))
     monkeypatch.setattr(envutil, "_device_lock_fd", None)
 
     holder = subprocess.Popen(

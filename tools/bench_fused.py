@@ -18,6 +18,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from poseidon_tpu.utils.hatches import hatch_flag  # noqa: E402 - needs path
+
 
 def make_instance(E, M, seed, contended):
     from poseidon_tpu.ops.transport import INF_COST
@@ -136,7 +138,7 @@ def main():
         (128, 4096, False),  # above VMEM: the wave tier
         (128, 10000, True),  # the 10k-machine wave shape, contended
     ]
-    if os.environ.get("POSEIDON_BENCH_FUSED_SMOKE"):
+    if hatch_flag("POSEIDON_BENCH_FUSED_SMOKE"):
         # CPU smoke: interpret-mode Pallas is an emulator — keep it tiny.
         fused_shapes = [(16, 128, False)]
         tiled_shapes = []
