@@ -5,7 +5,8 @@
 PY ?= python
 
 .PHONY: all test test-fast bench protos native verify lint lint-fast \
-  bench-smoke soak-smoke trace-smoke perf-gate demo demo-stop clean
+  bench-smoke soak-smoke trace-smoke profile-smoke perf-gate demo \
+  demo-stop clean
 
 all: protos native lint test
 
@@ -38,10 +39,20 @@ soak-smoke:
 
 # Observability smoke (docs/OBSERVABILITY.md): one features-config
 # round with POSEIDON_TRACE=1, exported to out/trace_smoke.json and
-# validated — Perfetto-loadable format, round->stage span nesting, and
-# span/stagetimer parity within 5%.
+# validated — Perfetto-loadable format, round->stage span nesting,
+# span/stagetimer parity within 5%, and at least one conv.* counter
+# track rendered from the solver convergence telemetry.
 trace-smoke:
 	$(PY) tools/trace_smoke.py
+
+# Solver-introspection smoke (docs/OBSERVABILITY.md): a CPU-pinned
+# telemetry-on contended round — convergence-curve artifact validated
+# (out/profile_smoke.json), jax profiler capture window exercised,
+# /debug/rounds + /debug/round/<n> + /healthz probed on a live
+# exporter, and a warm instrumented round held under BOTH
+# CompileLedger(budget=0) and TransferLedger(budget=0).
+profile-smoke:
+	$(PY) tools/profile_smoke.py
 
 # Perf-regression gate (tools/bench_compare.py): diff a fresh bench
 # artifact's timing series (headline p50s + per-stage features timings)
@@ -132,7 +143,7 @@ lint-fast:
 # baseline is judged against its predecessors — either way a regression
 # past the band fails verify.  POSEIDON_PERF_GATE=warn downgrades to
 # warn-only on known-noisy machines.
-verify: lint bench-smoke soak-smoke trace-smoke perf-gate
+verify: lint bench-smoke soak-smoke trace-smoke profile-smoke perf-gate
 	$(PY) __graft_entry__.py
 
 # Backgrounded demo loop with its PID on record (out/demo.pid), so the
@@ -157,6 +168,8 @@ demo-stop:
 clean: demo-stop
 	rm -f poseidon_tpu/native/_graphcore.so
 	rm -rf out/soak
-	rm -f out/trace_smoke.json out/trace_features.json out/bench_gate.jsonl
-	rm -f out/posecheck.json
+	rm -f out/trace_smoke.json out/trace_smoke_conv.json
+	rm -f out/trace_features.json out/bench_gate.jsonl
+	rm -f out/posecheck.json out/profile_smoke.json
+	rm -rf out/profile_smoke_jax
 	find . -name __pycache__ -type d -exec rm -rf {} +

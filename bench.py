@@ -313,6 +313,32 @@ def submit_population(state, num_tasks: int, num_ecs: int, seed: int):
         )
 
 
+def contended_cluster(machines: int = 40, ecs: int = 24, per_ec: int = 10,
+                      prefix: str = "cc"):
+    """A small cluster whose demand sits just past comfortable capacity,
+    so the greedy start cannot host-certify and the device ladder runs
+    real iterations — the shared recipe the smoke gates (trace-smoke's
+    counter-track window, profile-smoke) and the telemetry tests use to
+    guarantee a convergence curve gets captured.  ONE definition so a
+    threshold retune cannot leave one gate quietly un-contended."""
+    from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+    from poseidon_tpu.utils.ids import generate_uuid, task_uid
+
+    state = ClusterState()
+    for i in range(machines):
+        state.node_added(MachineInfo(
+            uuid=generate_uuid(f"{prefix}-m{i}"), cpu_capacity=4000,
+            ram_capacity=1 << 24, task_slots=8,
+        ))
+    for e in range(ecs):
+        for i in range(per_ec):
+            state.task_submitted(TaskInfo(
+                uid=task_uid(f"{prefix}-{e}", i), job_id=f"{prefix}-{e}",
+                cpu_request=300 + 37 * e, ram_request=1 << 18,
+            ))
+    return state
+
+
 def churn_step(state, rng, frac: int = 100):
     """Replace 1/frac of the tasks with same-shape resubmissions — the
     steady-state churn step, shared by the measured churn loop and the
@@ -397,6 +423,8 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
     wave_bf_sweeps = []
     wave_device_calls = []
     wave_entry_phase = []
+    wave_telem_samples = []
+    wave_telem_iters_to_90 = []
     placed = unsched = 0
     objective = 0
     for r in range(rounds):
@@ -411,6 +439,8 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
         wave_bf_sweeps.append(metrics.bf_sweeps)
         wave_device_calls.append(metrics.device_calls)
         wave_entry_phase.append(metrics.ladder_entry_phase)
+        wave_telem_samples.append(metrics.telem_samples)
+        wave_telem_iters_to_90.append(metrics.telem_iters_to_90)
         placed, unsched = metrics.placed, metrics.unscheduled
         objective = metrics.objective
         converged = converged and metrics.converged
@@ -502,6 +532,11 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
         "wave_bf_sweeps": wave_bf_sweeps,
         "wave_device_calls": wave_device_calls,
         "wave_entry_phase": wave_entry_phase,
+        # Convergence-telemetry roll-ups (informational, not gated:
+        # half-life / drain shift with tie-breaks; the curve itself
+        # lives in the round history + Perfetto counter tracks).
+        "wave_telem_samples": wave_telem_samples,
+        "wave_telem_iters_to_90": wave_telem_iters_to_90,
         "churn_solve_iters": churn_solve_iters,
         "churn_device_calls": churn_device_calls,
         "churn_delta_hits": churn_delta_hits,
@@ -954,6 +989,7 @@ def build_artifact(rungs, target, parity, trace, features) -> dict:
         # above are not).
         for key in ("wave_solve_iters", "wave_bf_sweeps",
                     "wave_device_calls", "wave_entry_phase",
+                    "wave_telem_samples", "wave_telem_iters_to_90",
                     "churn_solve_iters", "churn_device_calls",
                     "churn_delta_hits"):
             if key in best:

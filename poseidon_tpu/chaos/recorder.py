@@ -115,6 +115,7 @@ class FlightRecorder:
         digest: str,
         placements: int,
         spans: Optional[List[dict]] = None,
+        counters: Optional[List[dict]] = None,
     ) -> None:
         record = {
             "round": round_index,
@@ -130,6 +131,10 @@ class FlightRecorder:
             # ``replay/flight.flight_timeline`` lowers these back to a
             # Perfetto-loadable Chrome trace of the failing round.
             record["spans"] = spans
+        if counters:
+            # Convergence counter samples (obs.trace counter tracks):
+            # flight_timeline re-renders them next to the spans.
+            record["counters"] = counters
         self.trace.rounds.append(record)
 
     def record_failure(self, round_index: int, kind: str,

@@ -290,6 +290,7 @@ def run_soak(
     try:
         _tracer.force = True
         obs_trace.drain_spans()  # a clean window: drop pre-soak spans
+        obs_trace.drain_counter_samples()
         for node_i in range(machines):
             kube.add_node(Node(
                 name=f"m{node_i:04d}",
@@ -434,6 +435,9 @@ def run_soak(
                 digest=digest,
                 placements=len(kube_truth),
                 spans=obs_trace.drain_spans(),
+                # Convergence counter samples ride next to the spans so
+                # flight_timeline re-renders the curves offline too.
+                counters=obs_trace.drain_counter_samples(),
             )
             if kube_truth != sched_view:
                 only_kube = sorted(

@@ -38,6 +38,8 @@ from poseidon_tpu.ops.transport import (
     solve_transport,
     sparse_adm_cells,
 )
+from poseidon_tpu.obs import history as _history
+from poseidon_tpu.obs import profile as _profile
 from poseidon_tpu.obs import trace as _trace
 from poseidon_tpu.utils.hatches import hatch_bool
 from poseidon_tpu.utils.stagetimer import stage as _stage
@@ -136,6 +138,19 @@ class RoundMetrics:
     # solves (length transport.NUM_PHASES; [] when nothing solved) —
     # the device-work decomposition the bench wave series gates on.
     solve_phase_iters: list = field(default_factory=list)
+    # On-device convergence telemetry roll-up (POSEIDON_SOLVE_TELEMETRY;
+    # ops/transport.SolveTelemetry): ring samples captured across the
+    # round's band solves, BF global-update firings observed in them,
+    # and — from the DOMINANT band's curve (the one with the most
+    # samples) — the active-excess decay half-life in iterations and
+    # the iterations until 90% of the initial active excess had
+    # drained.  All zero when telemetry is off or nothing solved; the
+    # full per-band curves ride the round-history ring (/debug/round/N)
+    # and Perfetto counter tracks, not this wire format.
+    telem_samples: int = 0
+    telem_gu_firings: int = 0
+    telem_decay_half_life: float = 0.0
+    telem_iters_to_90: int = 0
     # Which tier of the degraded-mode ladder served the round (worst
     # band wins): "pruned" (shortlist + full-plane certificate),
     # "dense" (full-plane solve), "host_greedy" (the last-resort
@@ -451,6 +466,12 @@ class RoundPlanner:
         self._pipeline_overlap = 0.0
         self._entry_phase_min = -1
         self._phase_iter_sums = None
+        # Per-band convergence curves ((band, SolveTelemetry) pairs)
+        # collected this round, and their JSON-safe digests — the round
+        # planner's contribution to /debug/round/<n> and the Perfetto
+        # counter tracks.
+        self._telem_curves: list = []
+        self.last_solve_curves: list = []
         # Worst degraded-mode tier used this round (index into _TIERS).
         self._tier_rank = -1
         # Chaos seam (poseidon_tpu/chaos): when set, an object whose
@@ -753,13 +774,25 @@ class RoundPlanner:
                 cost_rows_rebuilt=metrics.cost_rows_rebuilt,
                 cost_cols_rebuilt=metrics.cost_cols_rebuilt,
                 pipeline_overlap_s=metrics.pipeline_overlap_s,
+                telem_samples=metrics.telem_samples,
+                telem_iters_to_90=metrics.telem_iters_to_90,
                 converged=metrics.converged,
             )
+        # Round-history ring (/debug/rounds): every completed round —
+        # bench-driven, service-driven, or soak-driven — lands here, so
+        # a live process is interrogable without the flight recorder.
+        _history.default_history().record(
+            metrics.to_dict(), curves=self.last_solve_curves
+        )
         return deltas, metrics
 
     def _schedule_round(self) -> Tuple[List[Delta], RoundMetrics]:
         t0 = time.perf_counter()
         st = self.state
+        # Rounds that never reach _solve_banded (quiet / zero-EC) carry
+        # no convergence curves — a stale previous round's must not
+        # masquerade as theirs in the round history.
+        self.last_solve_curves = []
 
         # Quiet-round fast path: no mutation since the committed result of
         # the last round and nothing left unscheduled (the starvation
@@ -881,10 +914,17 @@ class RoundPlanner:
             metrics.unscheduled = 0
 
         try:
-            flows = self._solve_banded(
-                ecs, mt, metrics, on_band=on_band,
-                on_band_reset=on_band_reset,
-            )
+            # Hatch-gated jax.profiler capture around the solve window
+            # (POSEIDON_JAX_PROFILE=<dir>); the artifact path lands on
+            # the round span so a slow solve on the timeline links to
+            # its XLA-level profile.
+            with _profile.solve_profile(metrics.round_index) as ppath:
+                flows = self._solve_banded(
+                    ecs, mt, metrics, on_band=on_band,
+                    on_band_reset=on_band_reset,
+                )
+            if ppath is not None:
+                _trace.current().set(profile_path=ppath)
         except BaseException:
             # A failed solve must not leave an orphaned worker chunk
             # mutating shared state (prior_machine hints) for a round
@@ -1185,6 +1225,7 @@ class RoundPlanner:
         self._tier_rank = -1
         self._entry_phase_min = -1
         self._phase_iter_sums = None
+        self._telem_curves = []
         remaining = sorted(set(bands.tolist()))
         if len(remaining) > 1:
             chained = self._try_chained_wave(
@@ -1253,10 +1294,12 @@ class RoundPlanner:
             t_band = time.perf_counter()
             with _stage("round.solve_band"):
                 sol = self._solve_band(band, ecs_b, cm, col_cap, mt.uuids)
+            t_band_end = time.perf_counter()
             if pipe is not None:
                 self._pipeline_overlap += pipe.overlap_with(
-                    t_band, time.perf_counter()
+                    t_band, t_band_end
                 )
+            self._note_solve_telemetry(band, sol, t_band, t_band_end)
             objective += sol.objective
             gap = max(gap, sol.gap_bound)
             iters += sol.iterations
@@ -1311,7 +1354,51 @@ class RoundPlanner:
             metrics.solve_phase_iters = list(self._phase_iter_sums)
         if self._tier_rank >= 0:
             metrics.solve_tier = self._TIERS[self._tier_rank]
+        self._fold_telemetry(metrics)
         return flows_full
+
+    def _note_solve_telemetry(self, band, sol, t0: float,
+                              t1: float) -> None:
+        """Collect one band solve's convergence curve (when the
+        telemetry ring captured one) and, under span recording, lay it
+        onto the timeline as Perfetto counter tracks spread linearly
+        over the solve's wall window [t0, t1]."""
+        t = sol.telemetry
+        if t is None or t.samples() == 0:
+            return
+        self._telem_curves.append((int(band), t))
+        tr = _trace.tracer()
+        if tr.tracing():
+            tr.counter_series("conv.active_excess", t0, t1,
+                              t.active_excess)
+            tr.counter_series("conv.active_rows", t0, t1, t.active_rows)
+            if t.shard_excess is not None:
+                # Per-device work lanes (mesh-sharded solves).
+                for i, row in enumerate(t.shard_excess):
+                    tr.counter_series(f"conv.shard{i}.excess", t0, t1,
+                                      row)
+
+    def _fold_telemetry(self, metrics: RoundMetrics) -> None:
+        """Roll the collected curves into the RoundMetrics scalars and
+        publish the JSON-safe digests (``last_solve_curves`` — the
+        round-history ring's curve payload)."""
+        self.last_solve_curves = [
+            dict(band=b, **t.digest()) for b, t in self._telem_curves
+        ]
+        if not self._telem_curves:
+            return
+        # Half-life / drain come from the DOMINANT curve — the band
+        # with the most captured iterations is the round's device-work
+        # story; summing half-lives across trivial bands would bury it.
+        dominant = max(self._telem_curves, key=lambda bt: bt[1].samples())
+        metrics.telem_samples = sum(
+            t.samples() for _, t in self._telem_curves
+        )
+        metrics.telem_gu_firings = sum(
+            t.gu_firings() for _, t in self._telem_curves
+        )
+        metrics.telem_decay_half_life = dominant[1].decay_half_life()
+        metrics.telem_iters_to_90 = dominant[1].iters_to_drain(0.9)
 
     def _maybe_pipeline(self, n_bands: int):
         """The cross-band pipeline, when it can pay: more than one band

@@ -24,12 +24,17 @@ a dict probe + locked float add.
 
 from __future__ import annotations
 
+import json
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from poseidon_tpu.obs import trace as _trace
+from poseidon_tpu.obs.history import RoundHistory, default_history
+
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+JSON_CONTENT_TYPE = "application/json; charset=utf-8"
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -295,28 +300,120 @@ def default_registry() -> Registry:
     return _REGISTRY
 
 
+# ----------------------------------------------------------- health state
+
+# Process-wide liveness facts behind /healthz: stamped by the exporter
+# feeds (observe_round / observe_loop) so the endpoint reports what the
+# process has actually been DOING, not just that a socket answers.
+# Timestamps come from obs.trace.monotime() — the telemetry plane's one
+# clock owner (posecheck determinism confinement).
+_HEALTH_LOCK = threading.Lock()
+
+
+def _fresh_health() -> dict:
+    return {
+        "last_round_ts": None,     # monotime() of the last observed round
+        "last_round_index": None,
+        "rounds_observed": 0,
+        "loop_fatal": False,
+        "loop_rounds": 0,
+        "consecutive_failures": 0,
+        "crash_loop_budget": 0,
+        "resyncs": 0,
+    }
+
+
+_HEALTH = _fresh_health()
+
+
+def health_report(history: Optional[RoundHistory] = None) -> dict:
+    """The /healthz JSON payload: ok flag + last-round age + loop
+    hardening state.  ``ok`` is False only on a FATAL loop stop (the
+    crash-loop budget fired) — a process that has simply never
+    scheduled yet is alive, just idle (``last_round_age_s`` null).
+    ``history`` is the serving endpoint's round-history ring (the SAME
+    one /debug/rounds reads, so the two endpoints can never disagree
+    about liveness); defaults to the process-wide ring."""
+    now = _trace.monotime()
+    with _HEALTH_LOCK:
+        h = dict(_HEALTH)
+    ts = h.pop("last_round_ts")
+    if ts is None:
+        # Processes that drive the planner directly (bench, tools)
+        # never feed observe_round/observe_loop — the round-history
+        # ring is then the liveness signal.
+        latest = (history or default_history()).latest()
+        if latest is not None:
+            h["last_round_index"], ts = latest
+    h["last_round_age_s"] = (
+        round(now - ts, 3) if ts is not None else None
+    )
+    h["ok"] = not h["loop_fatal"]
+    return h
+
+
+def _reset_health() -> None:
+    """Test hook: the health facts are process-global like the registry."""
+    with _HEALTH_LOCK:
+        _HEALTH.clear()
+        _HEALTH.update(_fresh_health())
+
+
 # ----------------------------------------------------------------- exporter
 
 
 class _Handler(BaseHTTPRequestHandler):
     registry: Registry = _REGISTRY
+    history: RoundHistory = default_history()
 
-    def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
-        path = self.path.split("?", 1)[0]
-        if path == "/metrics":
-            body = self.registry.expose().encode("utf-8")
-            ctype = CONTENT_TYPE
-        elif path in ("/", "/healthz"):
-            body = b"ok\n"
-            ctype = "text/plain; charset=utf-8"
-        else:
-            self.send_error(404)
-            return
-        self.send_response(200)
+    def _reply(self, body: bytes, ctype: str, status: int = 200) -> None:
+        self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _reply_json(self, obj, status: int = 200) -> None:
+        self._reply(
+            (json.dumps(obj) + "\n").encode("utf-8"),
+            JSON_CONTENT_TYPE, status,
+        )
+
+    def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            self._reply(self.registry.expose().encode("utf-8"),
+                        CONTENT_TYPE)
+        elif path in ("/", "/healthz"):
+            report = health_report(self.history)
+            # A fatally-stopped loop fails liveness (503) so the
+            # orchestrator restarts the pod instead of scraping a
+            # zombie; everything else — idle included — is alive.
+            self._reply_json(report, 200 if report["ok"] else 503)
+        elif path == "/debug/rounds":
+            self._reply_json({
+                "capacity": self.history.capacity(),
+                "retained": len(self.history),
+                "rounds": self.history.summaries(),
+            })
+        elif path.startswith("/debug/round/"):
+            tail = path[len("/debug/round/"):]
+            try:
+                idx = int(tail)
+            except ValueError:
+                self._reply_json({"error": f"bad round index {tail!r}"},
+                                 400)
+                return
+            rec = self.history.get(idx)
+            if rec is None:
+                self._reply_json({
+                    "error": f"round {idx} not retained",
+                    "retained_range": self.history.retained_range(),
+                }, 404)
+                return
+            self._reply_json(rec)
+        else:
+            self.send_error(404)
 
     def log_message(self, fmt, *args) -> None:  # scrapes are not log news
         pass
@@ -327,7 +424,8 @@ class MetricsServer:
     endpoint; deploy/poseidon-deployment.yaml annotates the port)."""
 
     def __init__(self, address: str = "0.0.0.0:9100",
-                 registry: Optional[Registry] = None) -> None:
+                 registry: Optional[Registry] = None,
+                 history: Optional[RoundHistory] = None) -> None:
         # Bind happens in start(), not here: an instance whose owner
         # fails before start() (e.g. Poseidon.start raising on an
         # unhealthy service) must not hold the port hostage until GC.
@@ -335,7 +433,8 @@ class MetricsServer:
         self._bind = (host or "0.0.0.0", int(port))
         self._handler = type(
             "_BoundHandler", (_Handler,),
-            {"registry": registry or _REGISTRY},
+            {"registry": registry or _REGISTRY,
+             "history": history or default_history()},
         )
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -393,6 +492,10 @@ def observe_round(metrics, registry: Optional[Registry] = None) -> None:
     reg = registry or _REGISTRY
     d = metrics.to_dict() if hasattr(metrics, "to_dict") else dict(metrics)
     d.pop("schema", None)
+    with _HEALTH_LOCK:
+        _HEALTH["last_round_ts"] = _trace.monotime()
+        _HEALTH["last_round_index"] = d.get("round_index")
+        _HEALTH["rounds_observed"] += 1
     tier = d.pop("solve_tier", "none")
     tier_g = reg.gauge(
         "poseidon_round_solve_tier",
@@ -445,6 +548,17 @@ def observe_loop(stats, *, resyncs: int = 0, crash_loop_budget: int = 0,
     Cumulative LoopStats fields pin counters via ``set_total`` (the
     dataclass owns monotonicity); instantaneous ones are gauges."""
     reg = registry or _REGISTRY
+    with _HEALTH_LOCK:
+        _HEALTH["loop_fatal"] = bool(fatal)
+        _HEALTH["consecutive_failures"] = int(stats.consecutive_failures)
+        _HEALTH["crash_loop_budget"] = int(crash_loop_budget)
+        _HEALTH["resyncs"] = int(resyncs)
+        # In the GLUE process (no observe_round feed — RoundMetrics
+        # live service-side) the loop's own completed-round counter is
+        # the liveness signal: stamp last-round age off its advance.
+        if int(stats.rounds) > int(_HEALTH.get("loop_rounds") or 0):
+            _HEALTH["loop_rounds"] = int(stats.rounds)
+            _HEALTH["last_round_ts"] = _trace.monotime()
     for field in ("rounds", "placed", "preempted", "migrated",
                   "failed_rounds", "bind_failures", "requeued"):
         reg.counter(

@@ -1,0 +1,146 @@
+"""JAX profiler & device-memory bridge for the solver plane.
+
+Two narrow seams between the scheduler's own telemetry and jax's:
+
+- ``solve_profile(round_index)``: a hatch-gated ``jax.profiler.trace``
+  capture window.  With ``POSEIDON_JAX_PROFILE=<dir>`` set, the round
+  planner wraps its solve window in a profiler capture written to
+  ``<dir>/round_<n>`` and stamps the artifact path on the ``round``
+  span (``profile_path`` attribute) — so a timeline that shows a slow
+  solve links straight to the XLA-level profile of that exact window.
+  Unset (the default), the context manager is a no-op that never
+  imports the profiler.
+
+- ``observe_device_memory(registry)``: per-device ``memory_stats()``
+  gauges plus a live-buffer count, sampled at round boundaries by the
+  service (``service/server.py``).  This is the groundwork the sharded
+  tier's per-device work series needs: HBM in use / peak / limit per
+  device next to the per-shard convergence lanes.  Reads jax only when
+  it is already imported (the ``observe_ledger`` discipline — a
+  glue-only process must not pay a jax import for empty gauges).
+
+Determinism discipline: no clock reads here (obs/trace.py is the
+telemetry plane's clock owner); capture paths are keyed by round index,
+never wall time.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from contextlib import contextmanager
+from typing import Optional
+
+from poseidon_tpu.utils.hatches import hatch_str
+
+log = logging.getLogger("poseidon.obs.profile")
+
+# Latched False after the first failed capture attempt so a broken
+# profiler (missing plugin, unwritable dir) degrades to a warning once,
+# not one per round.
+_PROFILER_OK = True
+
+
+def profile_dir() -> str:
+    """The configured capture root ('' = profiling off)."""
+    return hatch_str("POSEIDON_JAX_PROFILE")
+
+
+@contextmanager
+def solve_profile(round_index: int):
+    """Capture window around one round's solve.
+
+    Yields the artifact directory when a capture is running, else None.
+    Failures to start/stop the profiler are contained here (a broken
+    profiler must never fail a schedule round).
+    """
+    global _PROFILER_OK
+    root = profile_dir()
+    if not root or not _PROFILER_OK:
+        yield None
+        return
+    path = os.path.join(root, f"round_{int(round_index):06d}")
+    try:
+        import jax
+
+        ctx = jax.profiler.trace(path)
+        ctx.__enter__()
+    except Exception as e:  # noqa: BLE001 - degrade, never fail the round
+        _PROFILER_OK = False
+        log.warning("jax profiler capture unavailable (%s: %s); "
+                    "disabling for this process", type(e).__name__, e)
+        yield None
+        return
+    try:
+        yield path
+    finally:
+        try:
+            ctx.__exit__(None, None, None)
+        except Exception as e:  # noqa: BLE001
+            _PROFILER_OK = False
+            log.warning("jax profiler capture failed to stop (%s: %s); "
+                        "disabling for this process", type(e).__name__, e)
+
+
+def observe_device_memory(registry=None) -> int:
+    """Feed per-device memory gauges into the Prometheus registry.
+
+    Exports, per device (labels ``device`` = platform:id):
+
+    - ``poseidon_device_bytes_in_use`` / ``_peak_bytes_in_use`` /
+      ``_bytes_limit`` from ``Device.memory_stats()`` (absent stats —
+      CPU backends — export nothing rather than zeros that read as
+      "empty accelerator");
+    - ``poseidon_live_buffers`` (unlabeled): process-wide live jax
+      array count, the leak canary the resident-operand cache and warm
+      frames are watched with.
+
+    Returns the number of devices that reported stats.  Reads jax ONLY
+    when already imported.
+    """
+    if "jax" not in sys.modules:
+        return 0
+    import jax
+
+    from poseidon_tpu.obs import metrics as obs_metrics
+
+    reg = registry or obs_metrics.default_registry()
+    reported = 0
+    for dev in jax.devices():
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # noqa: BLE001 - backends without the API
+            stats = None
+        if not stats:
+            continue
+        label = f"{dev.platform}:{dev.id}"
+        for stat_key, gauge_name in (
+            ("bytes_in_use", "poseidon_device_bytes_in_use"),
+            ("peak_bytes_in_use", "poseidon_device_peak_bytes_in_use"),
+            ("bytes_limit", "poseidon_device_bytes_limit"),
+        ):
+            if stat_key in stats:
+                reg.gauge(
+                    gauge_name,
+                    f"Device memory_stats()['{stat_key}'] sampled at "
+                    "round boundaries",
+                    ("device",),
+                ).set(float(stats[stat_key]), label)
+        reported += 1
+    try:
+        live = len(jax.live_arrays())
+    except Exception:  # noqa: BLE001
+        live = -1
+    if live >= 0:
+        reg.gauge(
+            "poseidon_live_buffers",
+            "Live jax arrays in the process (leak canary for the "
+            "resident-operand cache and warm frames)",
+        ).set(float(live))
+    return reported
+
+
+def _reset_for_tests() -> None:
+    global _PROFILER_OK
+    _PROFILER_OK = True

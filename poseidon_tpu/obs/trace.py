@@ -46,8 +46,24 @@ STAGE_ENV = "POSEIDON_STAGE_TIMERS"
 # bound.  Past the cap, spans are dropped (counted in ``dropped``) while
 # totals keep accumulating — the aggregate view stays honest.
 MAX_SPANS = 200_000
+# Counter-sample cap (Perfetto counter tracks — the convergence-curve
+# series): a 512-sample curve per band solve adds up fast in a long
+# traced window, so the buffer is bounded like the span one.
+MAX_COUNTER_SAMPLES = 500_000
 
 _ids = itertools.count(1)
+
+
+def monotime() -> float:
+    """Monotonic timestamp for the rest of the telemetry plane.
+
+    The tracer is the ONE clock owner in ``obs/`` (posecheck
+    determinism confinement): modules that need an age or a timestamp —
+    the /healthz liveness report, the round-history ring — call this
+    instead of reading ``time`` themselves, so metrics and timeline can
+    never disagree about what clock they are on.  Same epoch as span
+    timestamps (``time.perf_counter``)."""
+    return time.perf_counter()
 
 
 class _NullSpan:
@@ -145,15 +161,19 @@ class Span:
 class Tracer:
     """Process-wide span recorder + per-name duration aggregator."""
 
-    def __init__(self, max_spans: int = MAX_SPANS) -> None:
+    def __init__(self, max_spans: int = MAX_SPANS,
+                 max_counter_samples: int = MAX_COUNTER_SAMPLES) -> None:
         self._lock = threading.Lock()
         self._tl = threading.local()
         self._spans: List[dict] = []
+        self._counter_samples: List[dict] = []
         self._totals: Dict[str, float] = {}
         self._counts: Dict[str, int] = {}
         self._epoch = time.perf_counter()
         self.max_spans = max_spans
+        self.max_counter_samples = max_counter_samples
         self.dropped = 0
+        self.dropped_counters = 0
         # Overrides the env gate when not None (harness/test control —
         # the chaos soak forces recording on for flight-trace spans
         # without mutating the process environment).
@@ -214,12 +234,73 @@ class Tracer:
             self._counts.clear()
 
     def reset(self) -> None:
-        """Clear totals AND the recorded span buffer."""
+        """Clear totals AND the recorded span/counter buffers."""
         with self._lock:
             self._totals.clear()
             self._counts.clear()
             self._spans.clear()
+            self._counter_samples.clear()
             self.dropped = 0
+            self.dropped_counters = 0
+
+    # ------------------------------------------------------------- counters
+
+    def counter(self, name: str, value, ts: Optional[float] = None) -> None:
+        """Record one counter sample (a Perfetto counter-track point).
+
+        ``ts`` is an absolute ``time.perf_counter()`` timestamp (the
+        caller's own measurement — e.g. a solve window endpoint);
+        defaults to now.  No-op unless span recording is on: counter
+        tracks only make sense next to a span timeline."""
+        if not self.tracing():
+            return
+        t = (ts if ts is not None else time.perf_counter()) - self._epoch
+        rec = {"name": name, "ts": t, "value": float(value)}
+        with self._lock:
+            if len(self._counter_samples) < self.max_counter_samples:
+                self._counter_samples.append(rec)
+            else:
+                self.dropped_counters += 1
+
+    def counter_series(self, name: str, t0: float, t1: float,
+                       values) -> None:
+        """Record a whole series distributed evenly over the window
+        [t0, t1] (absolute ``perf_counter`` endpoints) — how a device
+        solve's per-iteration convergence curve lands on the timeline:
+        the host only knows the solve's wall window, so samples are
+        laid out linearly across it.  No-op when recording is off."""
+        if not self.tracing():
+            return
+        values = list(values)
+        n = len(values)
+        if n == 0:
+            return
+        span_s = max(t1 - t0, 0.0)
+        step = span_s / max(n - 1, 1)
+        recs = [
+            {"name": name, "ts": (t0 + i * step) - self._epoch,
+             "value": float(v)}
+            for i, v in enumerate(values)
+        ]
+        with self._lock:
+            room = self.max_counter_samples - len(self._counter_samples)
+            if room >= n:
+                self._counter_samples.extend(recs)
+            else:
+                self._counter_samples.extend(recs[:max(room, 0)])
+                self.dropped_counters += n - max(room, 0)
+
+    def counter_samples(self) -> List[dict]:
+        with self._lock:
+            return list(self._counter_samples)
+
+    def drain_counter_samples(self) -> List[dict]:
+        """Return AND clear the counter samples (the flight recorder's
+        per-round window, like ``drain_spans``)."""
+        with self._lock:
+            out = self._counter_samples
+            self._counter_samples = []
+            return out
 
     # -------------------------------------------------------------- recorded
 
@@ -236,7 +317,7 @@ class Tracer:
             return out
 
     def export_chrome_trace(self, path: Optional[str] = None) -> dict:
-        obj = chrome_trace(self.spans())
+        obj = chrome_trace(self.spans(), self.counter_samples())
         if path is not None:
             d = os.path.dirname(path)
             if d:
@@ -250,7 +331,8 @@ class Tracer:
 # ------------------------------------------------------- chrome trace format
 
 
-def chrome_trace(spans: List[dict]) -> dict:
+def chrome_trace(spans: List[dict],
+                 counters: Optional[List[dict]] = None) -> dict:
     """Lower recorded spans to Chrome trace-event JSON (the Trace Event
     Format's complete ``"ph": "X"`` events), loadable in Perfetto.
 
@@ -259,6 +341,11 @@ def chrome_trace(spans: List[dict]) -> dict:
     interval containment), with explicit ``span_id``/``parent_id`` args
     kept for offline joins.  Thread-name metadata events give each
     recorded thread a labeled lane.
+
+    ``counters`` (``Tracer.counter_samples()`` records) lower to
+    ``"ph": "C"`` counter events — Perfetto renders each distinct name
+    as its own counter track under the process, which is how the
+    solver's convergence curves land next to the span lanes.
     """
     pid = os.getpid()
     events: List[dict] = []
@@ -284,12 +371,29 @@ def chrome_trace(spans: List[dict]) -> dict:
             "args": args,
         })
     events.sort(key=lambda e: (e["tid"], e["ts"], -e["dur"]))
+    counter_events: List[dict] = []
+    for c in counters or ():
+        counter_events.append({
+            "name": str(c["name"]),
+            "cat": "poseidon",
+            "ph": "C",
+            "ts": int(round(c["ts"] * 1e6)),
+            "pid": pid,
+            # Counter tracks are per (pid, name) in Perfetto; tid 0
+            # keeps them off the span lanes.
+            "tid": 0,
+            "args": {"value": float(c["value"])},
+        })
+    counter_events.sort(key=lambda e: (e["name"], e["ts"]))
     meta = [
         {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
          "args": {"name": name}}
         for tid, name in sorted(thread_names.items())
     ]
-    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+    return {
+        "traceEvents": meta + events + counter_events,
+        "displayTimeUnit": "ms",
+    }
 
 
 def _json_safe(v):
@@ -327,6 +431,27 @@ def validate_chrome_trace(obj: dict) -> List[str]:
     for i, e in enumerate(events):
         ph = e.get("ph")
         if ph == "M":
+            continue
+        if ph == "C":
+            # Counter events: name/ts/pid plus a numeric args dict (the
+            # series values Perfetto plots).  They live outside the
+            # span-nesting rules entirely.
+            for key in ("name", "ts", "pid"):
+                if key not in e:
+                    problems.append(f"counter event {i}: missing {key}")
+            if not isinstance(e.get("ts", 0), int):
+                problems.append(
+                    f"counter event {i}: ts must be integer us"
+                )
+            cargs = e.get("args")
+            if not isinstance(cargs, dict) or not cargs or not all(
+                isinstance(v, (int, float)) and not isinstance(v, bool)
+                for v in cargs.values()
+            ):
+                problems.append(
+                    f"counter event {i}: args must be a non-empty dict "
+                    "of numeric series values"
+                )
             continue
         if ph != "X":
             problems.append(f"event {i}: unsupported ph {ph!r}")
@@ -385,6 +510,17 @@ def validate_chrome_trace(obj: dict) -> List[str]:
     return problems
 
 
+def counter_tracks(obj: dict) -> Dict[str, int]:
+    """{counter-track name: sample count} of a trace-event JSON object
+    — what ``make trace-smoke`` / ``make profile-smoke`` assert on."""
+    tracks: Dict[str, int] = {}
+    for e in obj.get("traceEvents", ()):
+        if e.get("ph") == "C":
+            name = str(e.get("name", "?"))
+            tracks[name] = tracks.get(name, 0) + 1
+    return tracks
+
+
 def span_totals(spans: List[dict]) -> Dict[str, Tuple[float, int]]:
     """Aggregate recorded spans to the stagetimer shape
     ({name: (total_seconds, calls)}) — the parity check's other side."""
@@ -440,6 +576,22 @@ def spans() -> List[dict]:
 
 def drain_spans() -> List[dict]:
     return _TRACER.drain_spans()
+
+
+def counter(name: str, value, ts: Optional[float] = None) -> None:
+    _TRACER.counter(name, value, ts=ts)
+
+
+def counter_series(name: str, t0: float, t1: float, values) -> None:
+    _TRACER.counter_series(name, t0, t1, values)
+
+
+def counter_samples() -> List[dict]:
+    return _TRACER.counter_samples()
+
+
+def drain_counter_samples() -> List[dict]:
+    return _TRACER.drain_counter_samples()
 
 
 def export_chrome_trace(path: Optional[str] = None) -> dict:

@@ -167,6 +167,213 @@ class TransportSolution:
     # (0 = full cold ladder, NUM_PHASES = answered with no device
     # ladder at all) — the "ladder entry phase" telemetry series.
     entry_phase: int = 0
+    # Per-iteration convergence curve captured on device
+    # (POSEIDON_SOLVE_TELEMETRY; decode_telemetry).  None when the
+    # telemetry ring is off, the solve was answered without a device
+    # ladder (host-certificate returns), or the kernel path does not
+    # carry the ring (fused coarse / chained wrappers).
+    telemetry: Optional["SolveTelemetry"] = None
+
+
+# ------------------------------------------------------ solve telemetry ring
+# Row layout of the on-device convergence-telemetry ring — ONE layout
+# shared by the lax, fused, and tiled kernels (and extended with
+# per-shard rows by the mesh-sharded path), so the host decode cannot
+# drift per kernel.  The ring is a fixed [TELEM_ROWS(+shards), CAP]
+# int32 buffer (static shapes per the retrace-guard rules); iteration
+# ``it`` writes column ``it % CAP``, so solves shorter than CAP carry
+# their full curve and longer ones the last CAP samples.
+TELEM_ROWS = 8
+_TR_ITER = 0      # global iteration index (across phases)
+_TR_EXCESS = 1    # total ACTIVE excess entering the iteration
+_TR_ROWS = 2      # EC rows with positive excess
+_TR_COLS = 3      # machine columns with positive excess
+_TR_EPS = 4       # the phase's epsilon rung
+_TR_GU = 5        # 1 when this iteration ran the BF global update
+_TR_BF = 6        # Bellman-Ford sweeps spent this iteration
+# row 7 reserved; per-shard active machine-side excess rows start at
+# TELEM_ROWS when the sharded wrapper requests them.
+
+
+def solve_telemetry_cap() -> int:
+    """Ring capacity (samples) for the convergence-telemetry buffers;
+    0 = telemetry off (the kernels then trace today's program
+    bit-identically — no ring threading at all).  Read OUTSIDE jit (the
+    cap is a static argument / compile key, like iter_unroll's value);
+    rounded up to a 128-lane multiple so the fused kernel's VMEM ring
+    tiles cleanly."""
+    if not hatch_bool("POSEIDON_SOLVE_TELEMETRY"):
+        return 0
+    cap = hatch_int("POSEIDON_SOLVE_TELEMETRY_CAP", 512)
+    if cap <= 0:
+        return 0
+    return -(-cap // 128) * 128
+
+
+def _telem_write(ring, slot, active, vals):
+    """Write one telemetry sample (column ``slot``) when ``active``.
+
+    ``vals`` are traced int32 scalars in TELEM-row order (shorter lists
+    leave the remaining rows untouched).  Pure vector ops on the
+    [R, CAP] ring — 2-D iota + masked selects — so the SAME helper
+    serves the XLA loops and the Mosaic-lowered fused kernel (scalar
+    stores to VMEM are rejected there)."""
+    lane = lax.broadcasted_iota(jnp.int32, ring.shape, 1)
+    row = lax.broadcasted_iota(jnp.int32, ring.shape, 0)
+    col = ring
+    mask = (lane == slot) & active
+    for i, v in enumerate(vals):
+        col = jnp.where(mask & (row == i), jnp.asarray(v, jnp.int32), col)
+    return col
+
+
+def _telem_vals(it_global, exc_e, exc_m, exc_t, eps, fired, sweeps,
+                telem_shards=0):
+    """The sample row values for one iteration, shape-agnostic over the
+    1-D (lax) and 2-D (fused/tiled) excess layouts.  With
+    ``telem_shards`` > 1 the machine-side active excess is additionally
+    split into per-shard sums (equal column blocks — the sharded
+    wrapper lays the machine axis over the mesh in exactly these
+    blocks), appended after the shared rows."""
+    tot = _active_excess(exc_e, exc_m, exc_t)
+    rows = jnp.sum((exc_e > 0).astype(jnp.int32))
+    cols = jnp.sum((exc_m > 0).astype(jnp.int32))
+    vals = [
+        it_global, tot, rows, cols,
+        jnp.asarray(eps, jnp.int32),
+        fired.astype(jnp.int32),
+        jnp.asarray(sweeps, jnp.int32),
+        jnp.int32(0),
+    ]
+    if telem_shards > 1:
+        shard = jnp.sum(
+            jnp.maximum(exc_m, 0).reshape(telem_shards, -1), axis=1
+        )
+        vals.extend(shard[i] for i in range(telem_shards))
+    return vals
+
+
+@dataclass
+class SolveTelemetry:
+    """Decoded per-iteration convergence curve of one device solve.
+
+    Arrays are aligned sample-wise (oldest first).  ``total_iters`` can
+    exceed ``samples()`` when the ring wrapped — the arrays then hold
+    the LAST ``cap`` iterations."""
+
+    iters: np.ndarray          # global iteration index per sample
+    active_excess: np.ndarray  # total active excess entering the iteration
+    active_rows: np.ndarray    # EC rows with positive excess
+    active_cols: np.ndarray    # machine columns with positive excess
+    eps: np.ndarray            # epsilon rung of the sample's phase
+    gu_fired: np.ndarray       # 1 where the BF global update ran
+    bf_sweeps: np.ndarray      # BF sweeps spent that iteration
+    total_iters: int
+    cap: int
+    # Per-shard machine-side active excess [S, n] (mesh-sharded solves
+    # only): the per-device work series the sharded tier's bench lanes
+    # consume.
+    shard_excess: Optional[np.ndarray] = None
+
+    def samples(self) -> int:
+        return int(self.iters.size)
+
+    def gu_firings(self) -> int:
+        return int(self.gu_fired.sum())
+
+    def wrapped(self) -> bool:
+        return self.total_iters > self.samples()
+
+    def decay_half_life(self) -> float:
+        """Iterations for the active excess to first drop to half its
+        initial sample (0.0 when it never did within the window)."""
+        return float(self._iters_to_fraction(0.5))
+
+    def iters_to_drain(self, frac: float = 0.9) -> int:
+        """Iterations until ``frac`` of the initial active excess had
+        drained (the 'iters-to-90%-drain' roll-up); ``total_iters``
+        when the window never crossed it."""
+        got = self._iters_to_fraction(1.0 - frac)
+        return int(got if got else self.total_iters)
+
+    def _iters_to_fraction(self, keep: float) -> int:
+        if self.samples() == 0:
+            return 0
+        exc0 = int(self.active_excess[0])
+        if exc0 <= 0:
+            return 0
+        below = np.nonzero(self.active_excess <= exc0 * keep)[0]
+        if below.size == 0:
+            return 0
+        return int(self.iters[below[0]] - self.iters[0])
+
+    def digest(self, max_points: int = 64) -> dict:
+        """JSON-safe downsampled curve + summary scalars — the round-
+        history / flight-recorder / /debug wire shape.  Downsampling
+        keeps every ``stride``-th sample plus the last one."""
+        n = self.samples()
+        if n <= max_points:
+            idx = np.arange(n)
+        else:
+            stride = -(-n // max_points)
+            idx = np.arange(0, n, stride)
+            if idx[-1] != n - 1:
+                idx = np.append(idx, n - 1)
+        d = {
+            "samples": n,
+            "total_iters": int(self.total_iters),
+            "cap": int(self.cap),
+            "wrapped": self.wrapped(),
+            "gu_firings": self.gu_firings(),
+            "bf_sweeps": int(self.bf_sweeps.sum()),
+            "decay_half_life": self.decay_half_life(),
+            "iters_to_90": self.iters_to_drain(0.9),
+            "iters": [int(v) for v in self.iters[idx]],
+            "active_excess": [int(v) for v in self.active_excess[idx]],
+            "active_rows": [int(v) for v in self.active_rows[idx]],
+            "active_cols": [int(v) for v in self.active_cols[idx]],
+            "eps": [int(v) for v in self.eps[idx]],
+        }
+        if self.shard_excess is not None:
+            d["shard_excess"] = [
+                [int(v) for v in row[idx]] for row in self.shard_excess
+            ]
+        return d
+
+
+def decode_telemetry(ring, total_iters: int,
+                     telem_shards: int = 0) -> Optional[SolveTelemetry]:
+    """Host-side decode of a fetched telemetry ring (``None`` when the
+    ring is empty or no iteration ran).  Wrap-around reconstruction:
+    with ``total_iters > cap`` the oldest live sample sits at column
+    ``total_iters % cap``."""
+    ring = np.asarray(ring)
+    if ring.size == 0 or ring.shape[1] == 0:
+        return None
+    cap = int(ring.shape[1])
+    total_iters = int(total_iters)
+    if total_iters <= 0:
+        return None
+    if total_iters <= cap:
+        idx = np.arange(total_iters)
+    else:
+        start = total_iters % cap
+        idx = (np.arange(cap) + start) % cap
+    shard = None
+    if telem_shards > 1 and ring.shape[0] >= TELEM_ROWS + telem_shards:
+        shard = ring[TELEM_ROWS:TELEM_ROWS + telem_shards][:, idx]
+    return SolveTelemetry(
+        iters=ring[_TR_ITER, idx],
+        active_excess=ring[_TR_EXCESS, idx],
+        active_rows=ring[_TR_ROWS, idx],
+        active_cols=ring[_TR_COLS, idx],
+        eps=ring[_TR_EPS, idx],
+        gu_fired=ring[_TR_GU, idx],
+        bf_sweeps=ring[_TR_BF, idx],
+        total_iters=total_iters,
+        cap=cap,
+        shard_excess=shard,
+    )
 
 
 def _relabel_to(maxcand, has_adm, excess, p, eps):
@@ -400,7 +607,8 @@ def _excesses(F, Ffb, Fmt, *, supply, total):
 
 
 def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
-              max_iter_total, global_every, bf_max, adaptive):
+              max_iter_total, global_every, bf_max, adaptive,
+              telem_cap=0, telem_shards=0):
     """One epsilon phase: refine the carried flows to the new eps, then
     synchronous push/relabel until every excess is zero.
 
@@ -408,10 +616,22 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
     pathological instance then returns promptly as non-converged (the host
     repairs it and the planner retries cold) instead of running the device
     program long enough to trip the TPU runtime watchdog.
+
+    ``telem_cap``/``telem_shards`` are STATIC (compile-key) telemetry
+    knobs: with ``telem_cap`` 0 the carry and the traced program are
+    today's bit-for-bit; with a cap the carry grows a [R, cap] sample
+    ring written once per active iteration (_telem_write — the samples
+    never feed back into the iterate, so results are unchanged either
+    way).
     """
     E, M = C.shape
     admissible_arcs = C < INF_COST
-    (F_in, Ffb_in, Fmt_in, pe, pm, pt, total_iters, total_bf) = carry
+    if telem_cap:
+        (F_in, Ffb_in, Fmt_in, pe, pm, pt, total_iters, total_bf,
+         ring_in) = carry
+    else:
+        (F_in, Ffb_in, Fmt_in, pe, pm, pt, total_iters, total_bf) = carry
+        ring_in = None
 
     # --- refinement init: restore eps-optimality at the new (smaller) eps
     # with minimal disturbance to the carried flows.  A residual forward arc
@@ -440,7 +660,7 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
         return _excesses(F, Ffb, Fmt, supply=supply, total=total)
 
     def cond(st):
-        _F, _Ffb, _Fmt, exc, _pe, _pm, _pt, it, _bf, _gu = st
+        (_F, _Ffb, _Fmt, exc, _pe, _pm, _pt, it, _bf, _gu, *_t) = st
         exc_e, exc_m, exc_t = exc
         active = jnp.any(exc_e > 0) | jnp.any(exc_m > 0) | (exc_t > 0)
         return (
@@ -450,8 +670,11 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
         )
 
     def iterate(st):
-        F, Ffb, Fmt, exc, pe, pm, pt, it, bf, gu_state = st
+        (F, Ffb, Fmt, exc, pe, pm, pt, it, bf, gu_state, *t_rest) = st
         exc_e, exc_m, exc_t = exc
+        # Entering (pre-push) excesses: the telemetry sample's view —
+        # the same signal the adaptive cadence reads.
+        exc_entry = exc
         next_gu, gu_gap, last_exc = gu_state
         # Pre-push ACTIVE excess — the adaptive cadence's progress
         # signal (two small-vector reductions, noise next to the
@@ -611,14 +834,27 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
             global_every,
         )
 
+        # Telemetry sample for this iteration (no-op without a ring):
+        # written only while ``active`` — no-op tail sub-iterations and
+        # exhausted budgets leave the ring frozen with the state.
+        telem_out = ()
+        if telem_cap:
+            it_global = total_iters + it
+            telem_out = (_telem_write(
+                t_rest[0], jnp.remainder(it_global, telem_cap), active,
+                _telem_vals(it_global, *exc_entry, eps, fired, sweeps,
+                            telem_shards=telem_shards),
+            ),)
+
         # Inactive sub-iterations freeze the state EXACTLY.  Convergence
         # makes the updates above structurally zero, but budget
         # exhaustion does not (excess remains, pushes/relabels would
         # fire) — the select is what makes the gate sound for both.
         # (gu_state needs no select: _gu_advance only moves on ``fired``,
-        # which carries the same ``active`` gate.)
+        # which carries the same ``active`` gate; the ring's write mask
+        # carries it too.)
         (F_in, Ffb_in, Fmt_in, exc_in, pe_in, pm_in, pt_in, _it, _bf,
-         _gu) = st
+         _gu, *_t_in) = st
 
         def sel(new, old):
             return jnp.where(active, new, old)
@@ -628,7 +864,7 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
             jax.tree_util.tree_map(sel, exc, exc_in),
             sel(pe_new, pe_in), sel(pm_new, pm_in), sel(pt_new, pt_in),
             it + active.astype(jnp.int32), bf + sweeps, gu_state_new,
-        )
+        ) + telem_out
 
     # iter_unroll() iterations per while step: on TPU each lax.while_loop
     # step pays a fixed sync/predicate cost that at small (churn/
@@ -654,18 +890,24 @@ def _pr_phase(carry, eps, *, C, U, Uem, supply, cap, total, max_iter,
            jnp.int32(0))
     init = (F, Ffb, Fmt, exc0, pe, pm, pt, jnp.int32(0), jnp.int32(0),
             gu0)
-    F, Ffb, Fmt, _exc, pe, pm, pt, iters, bf, _gu = lax.while_loop(
-        cond, body, init
-    )
-    return (
-        F, Ffb, Fmt, pe, pm, pt, total_iters + iters, total_bf + bf
-    ), iters
+    if telem_cap:
+        init = init + (ring_in,)
+    (F, Ffb, Fmt, _exc, pe, pm, pt, iters, bf, _gu,
+     *t_out) = lax.while_loop(cond, body, init)
+    out = (F, Ffb, Fmt, pe, pm, pt, total_iters + iters, total_bf + bf)
+    if telem_cap:
+        out = out + (t_out[0],)
+    return out, iters
 
 
-@functools.partial(jax.jit, static_argnames=("max_iter", "scale"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_iter", "scale", "telem_cap", "telem_shards"),
+)
 def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
                   init_flows, init_fb, eps_sched, max_iter_total,
-                  global_every, bf_max, adaptive_bf=0, *, max_iter, scale):
+                  global_every, bf_max, adaptive_bf=0, *, max_iter, scale,
+                  telem_cap=0, telem_shards=0):
     """The jitted solve.  All inputs int32; shapes static.
 
     costs: [E, M] raw costs (INF_COST where inadmissible)
@@ -724,9 +966,13 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
         _pr_phase, C=C, U=U, Uem=Uem, supply=supply, cap=cap, total=total,
         max_iter=max_iter, max_iter_total=max_iter_total,
         global_every=global_every, bf_max=bf_max, adaptive=adaptive_bf,
+        telem_cap=telem_cap, telem_shards=telem_shards,
     )
     carry0 = (F0, Ffb0, Fmt0, pe, pm, pt, jnp.int32(0), jnp.int32(0))
-    (F, Ffb, Fmt, pe, pm, pt, iters, bf), phase_iters = lax.scan(
+    if telem_cap:
+        n_rows = TELEM_ROWS + (telem_shards if telem_shards > 1 else 0)
+        carry0 = carry0 + (jnp.zeros((n_rows, telem_cap), jnp.int32),)
+    (F, Ffb, Fmt, pe, pm, pt, iters, bf, *t_out), phase_iters = lax.scan(
         phase, carry0, eps_sched
     )
     prices = jnp.concatenate([pe, pm, pt[None]])
@@ -734,6 +980,11 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
     clean = (
         jnp.all(exc_e == 0) & jnp.all(exc_m == 0) & (exc_t == 0)
     )
+    if telem_cap:
+        # 8-tuple with the telemetry ring appended; callers that leave
+        # the cap at 0 keep today's 7-tuple contract (and program)
+        # bit-for-bit.
+        return F, Ffb, prices, iters, bf, clean, phase_iters, t_out[0]
     return F, Ffb, prices, iters, bf, clean, phase_iters
 
 
@@ -810,10 +1061,11 @@ def host_fetch(*dev_values, attempts: int = 3):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("max_iter", "scale", "impl", "interpret")
+    jax.jit,
+    static_argnames=("max_iter", "scale", "impl", "interpret", "telem_cap"),
 )
 def _solve_device_packed(big, vec, *, max_iter, scale, impl,
-                         interpret=False):
+                         interpret=False, telem_cap=0):
     """Packed-I/O twin of the three solve variants.
 
     The production TPU sits behind a tunnel whose per-transfer round
@@ -854,15 +1106,20 @@ def _solve_device_packed(big, vec, *, max_iter, scale, impl,
         from poseidon_tpu.ops.transport_fused import solve_device_fused
 
         out = solve_device_fused(*args, max_iter=max_iter, scale=scale,
-                                 interpret=interpret)
+                                 interpret=interpret, telem_cap=telem_cap)
     elif impl == "tiled":
         from poseidon_tpu.ops.transport_tiled import solve_device_tiled
 
         out = solve_device_tiled(*args, max_iter=max_iter, scale=scale,
-                                 interpret=interpret)
+                                 interpret=interpret, telem_cap=telem_cap)
     else:
-        out = _solve_device(*args, max_iter=max_iter, scale=scale)
-    F, Ffb, prices, iters, bf, clean, phase_iters = out
+        out = _solve_device(*args, max_iter=max_iter, scale=scale,
+                            telem_cap=telem_cap)
+    if telem_cap:
+        F, Ffb, prices, iters, bf, clean, phase_iters, telem = out
+    else:
+        F, Ffb, prices, iters, bf, clean, phase_iters = out
+        telem = jnp.zeros((TELEM_ROWS, 0), jnp.int32)
     # A certified warm round often returns the warm start bit-for-bit
     # (zero iterations, no clipping): the host already owns that matrix,
     # so flag it and let the host skip the [E, M] result fetch — the
@@ -875,6 +1132,11 @@ def _solve_device_packed(big, vec, *, max_iter, scale, impl,
                    clean.astype(jnp.int32),
                    unchanged.astype(jnp.int32)]),
         phase_iters.astype(jnp.int32),
+        # Convergence-telemetry ring, flattened onto the SAME small
+        # fetch: the ring rides the one transfer slot the packed path
+        # already pays, so TransferLedger(budget=0) holds with
+        # telemetry on.  Empty (0 elements) when the cap is 0.
+        telem.reshape(-1).astype(jnp.int32),
     ])
     return F, small
 
@@ -2151,6 +2413,10 @@ def solve_transport(
     # Adaptive global-update cadence — a traced operand, so flipping it
     # never mints a compile key (policy rationale: adaptive_bf_flag).
     adaptive_bf = adaptive_bf_flag()
+    # Convergence-telemetry ring capacity: STATIC (a compile key, like
+    # iter_unroll's value), read here on the host — never inside the
+    # traced program.  0 traces today's program bit-for-bit.
+    telem_cap = solve_telemetry_cap()
     vec = np.concatenate([
         supply_p, capacity_p, unsched_p, prices_p, fb_p,
         np.asarray(eps_sched, dtype=np.int32),
@@ -2185,6 +2451,7 @@ def solve_transport(
                     # (tests / CPU with POSEIDON_FUSED/TILED=1); compiled
                     # on the accelerator.
                     interpret=jax.default_backend() == "cpu",
+                    telem_cap=telem_cap,
                 )
                 # Fetch INSIDE the guard: dispatch is async, so execution-
                 # time errors surface here, not at the call above.
@@ -2216,7 +2483,7 @@ def solve_transport(
             with _stage("solve.device_wait"):
                 F_d, small_d = _solve_device_packed(
                     big_op, vec, max_iter=max_iter_per_phase,
-                    scale=int(scale), impl="lax",
+                    scale=int(scale), impl="lax", telem_cap=telem_cap,
                 )
                 # Fetch inside the retry: async dispatch surfaces
                 # execution/transfer errors at the first result read.
@@ -2255,6 +2522,13 @@ def solve_transport(
         except (AttributeError, RuntimeError):
             pass  # backends without async copy: fetch plain below
     phase_iters = small[o + 4:o + 4 + NUM_PHASES]
+    telemetry = None
+    if telem_cap:
+        ring_flat = small[o + 4 + NUM_PHASES:
+                          o + 4 + NUM_PHASES + TELEM_ROWS * telem_cap]
+        telemetry = decode_telemetry(
+            ring_flat.reshape(TELEM_ROWS, telem_cap), iters
+        )
     if unchanged:
         # The solve returned the warm start bit-for-bit; reuse the
         # host's own copy instead of fetching [E_pad, M_pad] back
@@ -2283,6 +2557,7 @@ def solve_transport(
     # Telemetry: how many cold-ladder rungs the start skipped (the
     # device ladder actually entered at eps_sched[0]).
     sol.entry_phase = ladder_entry_phase(eps0_cold, int(eps_sched[0]))
+    sol.telemetry = telemetry
     return sol
 
 
@@ -2540,4 +2815,5 @@ def solve_transport_selective(
         bf_sweeps=sol_r.bf_sweeps,
         phase_iters=sol_r.phase_iters,
         entry_phase=sol_r.entry_phase,
+        telemetry=sol_r.telemetry,
     )

@@ -39,11 +39,15 @@ from poseidon_tpu.ops.transport import (
     _POS,
     INF_COST,
     NUM_PHASES,
+    TELEM_ROWS,
     _active_excess,
     _gu_advance,
     _gu_fire,
     _relabel_to,
+    _telem_vals,
+    _telem_write,
     iter_unroll,
+    solve_telemetry_cap,
 )
 
 # VMEM working-set gate, CALIBRATED ON LIVE v5e (2026-07-31 session):
@@ -59,9 +63,15 @@ def fits_vmem(e_pad: int, m_pad: int) -> bool:
     # Budget the ALIGNED operand shape (_kernel_shape re-pads rows to 8
     # and lanes to 128): quarter-octave widths like 320 inflate ~1.2-1.5x
     # past the raw e_pad*m_pad, and a VMEM overflow at such an edge shape
-    # would latch the kernel off for shapes it serves fine.
+    # would latch the kernel off for shapes it serves fine.  The
+    # convergence-telemetry ring ([TELEM_ROWS, cap] carried through the
+    # while loop plus its output copy) rides the budget's calibrated
+    # ~17% headroom at the DEFAULT cap (~3 live copies = ~7% of it);
+    # only an operator-RAISED cap is charged here, shrinking the gated
+    # shape set instead of overflowing VMEM at the proven edge.
     ek, mk = _kernel_shape(e_pad, m_pad)
-    return ek * mk <= VMEM_ELEM_BUDGET
+    ring = 3 * TELEM_ROWS * max(0, solve_telemetry_cap() - 512)
+    return ek * mk + ring <= VMEM_ELEM_BUDGET
 
 
 def _kernel_shape(e_pad: int, m_pad: int):
@@ -112,8 +122,11 @@ def _phase_ladder_kernel(
     cap_ref,      # [1, M] column capacities
     Uem_ref,      # [E, M] per-arc capacity
     F0_ref, Ffb0_ref, Fmt0_ref, pe0_ref, pm0_ref, pt0_ref,
-    # outputs (VMEM except the SMEM scalar blocks)
+    # outputs (VMEM except the SMEM scalar blocks); with telem_cap > 0
+    # a trailing VMEM [TELEM_ROWS, cap] telemetry-ring output follows
+    # phase_out.
     F_out, Ffb_out, pe_out, pm_out, pt_out, stats_out, phase_out,
+    *rest, telem_cap=0,
 ):
     """The whole ladder in one kernel.
 
@@ -128,6 +141,7 @@ def _phase_ladder_kernel(
     the SMEM knobs vector for the same reason (scalar *loads* from a
     [1, 1] VMEM block are equally unsupported).
     """
+    telem_out = rest[0] if telem_cap else None
     E, M = C_ref.shape
     C = C_ref[:]
     adm = C < INF_COST
@@ -244,7 +258,7 @@ def _phase_ladder_kernel(
         Fmt_scr[:] = Fmt0_ref[:]
 
         def phase_body(k, carry):
-            tot_it, tot_bf = carry
+            tot_it, tot_bf, *t_carry = carry
             eps = eps_ref[k]
             F_in = F_out[:]
             Ffb_in = Ffb_out[:]
@@ -268,7 +282,7 @@ def _phase_ladder_kernel(
 
             def cond(st):
                 (_F, _Ffb, _Fmt, exc_e, exc_m, exc_t,
-                 _pe, _pm, _pt, it, _bf, _gu) = st
+                 _pe, _pm, _pt, it, _bf, _gu, *_t) = st
                 active = (
                     jnp.any(exc_e > 0) | jnp.any(exc_m > 0) | (exc_t > 0)
                 )
@@ -280,8 +294,11 @@ def _phase_ladder_kernel(
 
             def iterate(st):
                 (F, Ffb, Fmt, exc_e, exc_m, exc_t, pe, pm, pt, it, bf,
-                 gu_state) = st
+                 gu_state, *t_rest) = st
                 next_gu, gu_gap, last_exc = gu_state
+                # Entering (pre-push) excesses: the telemetry sample's
+                # view — the same signal the adaptive cadence reads.
+                exc_entry = (exc_e, exc_m, exc_t)
                 # Convergence AND budget per sub-iteration (exact budget
                 # semantics despite the group-level while cond) — same
                 # gate as the lax path.
@@ -415,11 +432,24 @@ def _phase_ladder_kernel(
                     global_every,
                 )
 
+                # Telemetry sample (vector masked writes only — scalar
+                # VMEM stores are rejected by Mosaic; _telem_write is
+                # iota + selects).  Write mask carries ``active``.
+                telem_new = ()
+                if telem_cap:
+                    it_global = tot_it + it
+                    telem_new = (_telem_write(
+                        t_rest[0], jnp.remainder(it_global, telem_cap),
+                        active,
+                        _telem_vals(it_global, *exc_entry, eps, fired,
+                                    sweeps),
+                    ),)
+
                 # Inactive sub-iterations freeze the state EXACTLY (the
                 # excess gates cover convergence but not budget
                 # exhaustion) — same select as the lax path.
                 (F_in, Ffb_in, Fmt_in, ee_in, em_in, et_in,
-                 pe_in, pm_in, pt_in, _it, _bf, _gu) = st
+                 pe_in, pm_in, pt_in, _it, _bf, _gu, *_t_in) = st
 
                 def sel(new, old):
                     return jnp.where(active, new, old)
@@ -432,7 +462,7 @@ def _phase_ladder_kernel(
                     sel(pt_new, pt_in),
                     it + active.astype(jnp.int32), bf + sweeps,
                     gu_state_new,
-                )
+                ) + telem_new
 
             unroll = iter_unroll()
 
@@ -445,9 +475,10 @@ def _phase_ladder_kernel(
                     jnp.int32(0), jnp.int32(0),
                     (jnp.int32(0), jnp.asarray(global_every, jnp.int32),
                      jnp.int32(0)))
-            (F, Ffb, Fmt, _ee, _em, _et, pe, pm, pt, iters, bf, _gu) = (
-                lax.while_loop(cond, body, init)
-            )
+            if telem_cap:
+                init = init + (t_carry[0],)
+            (F, Ffb, Fmt, _ee, _em, _et, pe, pm, pt, iters, bf, _gu,
+             *t_out) = lax.while_loop(cond, body, init)
             F_out[:] = F
             Ffb_out[:] = Ffb
             Fmt_scr[:] = Fmt
@@ -455,11 +486,21 @@ def _phase_ladder_kernel(
             pm_out[:] = pm
             pt_out[:] = pt
             phase_out[k] = iters
-            return tot_it + iters, tot_bf + bf
+            out = (tot_it + iters, tot_bf + bf)
+            if telem_cap:
+                out = out + (t_out[0],)
+            return out
 
-        tot_it, tot_bf = lax.fori_loop(
-            0, NUM_PHASES, phase_body, (jnp.int32(0), jnp.int32(0))
+        fori0 = (jnp.int32(0), jnp.int32(0))
+        if telem_cap:
+            fori0 = fori0 + (
+                jnp.zeros((TELEM_ROWS, telem_cap), jnp.int32),
+            )
+        tot_it, tot_bf, *t_final = lax.fori_loop(
+            0, NUM_PHASES, phase_body, fori0
         )
+        if telem_cap:
+            telem_out[:] = t_final[0]
 
         exc_e, exc_m, exc_t = excesses(F_out[:], Ffb_out[:], Fmt_scr[:])
         clean = (
@@ -474,16 +515,18 @@ def _phase_ladder_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("max_iter", "scale", "interpret")
+    jax.jit, static_argnames=("max_iter", "scale", "interpret", "telem_cap")
 )
 def solve_device_fused(costs, supply, capacity, unsched_cost, arc_cap,
                        init_prices, init_flows, init_fb, eps_sched,
                        max_iter_total, global_every, bf_max,
                        adaptive_bf=0, *,
-                       max_iter, scale, interpret=False):
+                       max_iter, scale, interpret=False, telem_cap=0):
     """Drop-in twin of transport._solve_device running the ladder as one
     Pallas kernel.  Same operand contract, same outputs
-    ``(F, Ffb, prices, iters, bf, clean, phase_iters)``; results are
+    ``(F, Ffb, prices, iters, bf, clean, phase_iters)`` — plus the
+    [TELEM_ROWS, telem_cap] convergence-telemetry ring appended when
+    ``telem_cap`` > 0, exactly like the lax twin; results are
     bit-identical to the lax path (asserted by tests in interpret mode).
 
     Callers guarantee ``fits_vmem(E, M)``; operands are re-padded here to
@@ -540,7 +583,7 @@ def solve_device_fused(costs, supply, capacity, unsched_cost, arc_cap,
         jnp.asarray(adaptive_bf, jnp.int32),
     ])
 
-    out_shapes = (
+    out_shapes = [
         jax.ShapeDtypeStruct((Ek, Mk), jnp.int32),          # F
         jax.ShapeDtypeStruct((Ek, 1), jnp.int32),           # Ffb
         jax.ShapeDtypeStruct((Ek, 1), jnp.int32),           # pe
@@ -548,19 +591,27 @@ def solve_device_fused(costs, supply, capacity, unsched_cost, arc_cap,
         jax.ShapeDtypeStruct((1, 1), jnp.int32),            # pt
         jax.ShapeDtypeStruct((4,), jnp.int32),              # stats (SMEM)
         jax.ShapeDtypeStruct((NUM_PHASES,), jnp.int32),     # phase (SMEM)
-    )
+    ]
     vm = functools.partial(pl.BlockSpec, memory_space=pltpu.VMEM)
     sm = functools.partial(pl.BlockSpec, memory_space=pltpu.SMEM)
-    F, Ffb, pe_o, pm_o, pt_o, stats, phase_iters = pl.pallas_call(
-        _phase_ladder_kernel,
-        out_shape=out_shapes,
+    out_specs = [vm(), vm(), vm(), vm(), vm(), sm(), sm()]
+    if telem_cap:
+        # The telemetry ring: lane-aligned VMEM output (telem_cap is a
+        # 128 multiple by construction — solve_telemetry_cap rounds).
+        out_shapes.append(
+            jax.ShapeDtypeStruct((TELEM_ROWS, telem_cap), jnp.int32)
+        )
+        out_specs.append(vm())
+    outs = pl.pallas_call(
+        functools.partial(_phase_ladder_kernel, telem_cap=telem_cap),
+        out_shape=tuple(out_shapes),
         in_specs=[
             sm(),                                    # eps_sched
             sm(),                                    # knobs
             vm(), vm(), vm(), vm(), vm(),            # C U sup cap Uem
             vm(), vm(), vm(), vm(), vm(), vm(),      # F0 Ffb0 Fmt0 pe pm pt
         ],
-        out_specs=(vm(), vm(), vm(), vm(), vm(), sm(), sm()),
+        out_specs=tuple(out_specs),
         interpret=interpret,
     )(
         eps_sched.astype(jnp.int32),
@@ -577,11 +628,15 @@ def solve_device_fused(costs, supply, capacity, unsched_cost, arc_cap,
         pm[None, :],
         pt[None, None],
     )
+    F, Ffb, pe_o, pm_o, pt_o, stats, phase_iters = outs[:7]
     prices = jnp.concatenate(
         [pe_o[:E, 0], pm_o[0, :M], pt_o[0]]
     )
-    return (
+    result = (
         F[:E, :M], Ffb[:E, 0], prices,
         stats[0], stats[1], stats[2].astype(jnp.bool_),
         phase_iters,
     )
+    if telem_cap:
+        result = result + (outs[7],)
+    return result

@@ -173,8 +173,15 @@ def solve_transport_sharded(
     if max_iter_total is None:
         max_iter_total = transport.NUM_PHASES * max_iter_per_phase
     transport._Telemetry.device_calls += 1
+    # Convergence-telemetry ring (static knobs, host-read): the sharded
+    # program additionally carries one per-shard machine-side
+    # active-excess row per mesh device — the per-device work series
+    # the sharded tier's bench lanes consume.  The ring is replicated
+    # (O(cap), not O(M)) and rides the single host_fetch batch below.
+    telem_cap = transport.solve_telemetry_cap()
+    telem_shards = n_dev if telem_cap else 0
     put = jax.device_put
-    flows, unsched, prices, iters, bf, clean, phase_iters = _solve_device(
+    out = _solve_device(
         put(jnp.asarray(costs_p), col),
         put(jnp.asarray(supply_p), repl),
         put(jnp.asarray(capacity_p), vec_m),
@@ -194,16 +201,23 @@ def solve_transport_sharded(
         # bit-identical under either setting.
         put(jnp.int32(transport.adaptive_bf_flag()), repl),
         max_iter=max_iter_per_phase, scale=int(scale),
+        telem_cap=telem_cap, telem_shards=telem_shards,
     )
+    if telem_cap:
+        flows, unsched, prices, iters, bf, clean, phase_iters, telem = out
+    else:
+        flows, unsched, prices, iters, bf, clean, phase_iters = out
+        telem = jnp.zeros((transport.TELEM_ROWS, 0), jnp.int32)
 
     # ONE explicit boundary fetch for every result — arrays AND the
-    # telemetry scalars.  The previous per-value `np.asarray`/`int()`
-    # conversions were each an implicit device->host sync (a blocking
-    # tunnel round trip apiece on the production accelerator, and a
-    # transfer-guard violation under TransferLedger budget-0 windows).
+    # telemetry scalars (the convergence ring included).  The previous
+    # per-value `np.asarray`/`int()` conversions were each an implicit
+    # device->host sync (a blocking tunnel round trip apiece on the
+    # production accelerator, and a transfer-guard violation under
+    # TransferLedger budget-0 windows).
     (flows, unsched, prices_full, iters, bf, clean,
-     phase_iters) = host_fetch(
-        flows, unsched, prices, iters, bf, clean, phase_iters,
+     phase_iters, telem) = host_fetch(
+        flows, unsched, prices, iters, bf, clean, phase_iters, telem,
     )
     flows = flows[:E, :M]
     unsched = unsched[:E]
@@ -221,4 +235,7 @@ def solve_transport_sharded(
     from poseidon_tpu.ops.transport import ladder_entry_phase
 
     sol.entry_phase = ladder_entry_phase(eps0_cold, int(eps_sched[0]))
+    sol.telemetry = transport.decode_telemetry(
+        telem, int(iters), telem_shards=telem_shards
+    )
     return sol

@@ -42,11 +42,14 @@ from poseidon_tpu.ops.transport import (
     _NEG,
     _POS,
     INF_COST,
+    TELEM_ROWS,
     _active_excess,
     _global_update,
     _gu_advance,
     _gu_fire,
     _relabel_to,
+    _telem_vals,
+    _telem_write,
 )
 from poseidon_tpu.ops.transport_fused import _cumsum_cols, _cumsum_rows
 
@@ -302,16 +305,23 @@ def _tiled_iteration(C, Uem, U2, sup2, cap2, F, Ffb2, Fmt2, pe2, pm2, pt,
 
 def _pr_phase_tiled(carry, eps, *, C, Uem, U2, sup2, cap2, total,
                     max_iter, max_iter_total, global_every, bf_max,
-                    adaptive, interpret):
+                    adaptive, interpret, telem_cap=0):
     """transport._pr_phase with the iteration body as one kernel launch.
 
     Operands are kernel-shaped (see _tiled_iteration); the refine step
     and the BF global update remain plain XLA (once per phase / every
     global_every-th iteration).  ``_global_update`` is reused verbatim
     from transport.py with reshaped views, so its arithmetic — and the
-    bf-sweep accounting — matches the lax path exactly.
+    bf-sweep accounting — matches the lax path exactly.  The telemetry
+    ring (``telem_cap`` static, 0 = today's program bit-for-bit) rides
+    THIS loop's carry — the Pallas iteration kernel is untouched.
     """
-    (F_in, Ffb_in, Fmt_in, pe, pm, pt, total_iters, total_bf) = carry
+    if telem_cap:
+        (F_in, Ffb_in, Fmt_in, pe, pm, pt, total_iters, total_bf,
+         ring_in) = carry
+    else:
+        (F_in, Ffb_in, Fmt_in, pe, pm, pt, total_iters, total_bf) = carry
+        ring_in = None
     E, Mk = C.shape
     adm = C < INF_COST
 
@@ -336,15 +346,18 @@ def _pr_phase_tiled(carry, eps, *, C, Uem, U2, sup2, cap2, total,
 
     def cond(st):
         (_F, _Ffb, _Fmt, exc_e, exc_m, exc_t, _pe, _pm, _pt, it,
-         _bf, _gu) = st
+         _bf, _gu, *_t) = st
         active = jnp.any(exc_e > 0) | jnp.any(exc_m > 0) | (exc_t > 0)
         return (
             (it < max_iter) & (total_iters + it < max_iter_total) & active
         )
 
     def body(st):
-        F, Ffb, Fmt, exc_e, exc_m, exc_t, pe, pm, pt, it, bf, gu_state = st
+        (F, Ffb, Fmt, exc_e, exc_m, exc_t, pe, pm, pt, it, bf, gu_state,
+         *t_rest) = st
         next_gu, gu_gap, last_exc = gu_state
+        # Entering (pre-push) excesses for the telemetry sample.
+        exc_entry = (exc_e, exc_m, exc_t)
         active = (
             (jnp.any(exc_e > 0) | jnp.any(exc_m > 0) | (exc_t > 0))
             & (it < max_iter)
@@ -386,6 +399,15 @@ def _pr_phase_tiled(carry, eps, *, C, Uem, U2, sup2, cap2, total,
             global_every,
         )
 
+        telem_out = ()
+        if telem_cap:
+            it_global = total_iters + it
+            telem_out = (_telem_write(
+                t_rest[0], jnp.remainder(it_global, telem_cap), active,
+                _telem_vals(it_global, *exc_entry, eps, is_global,
+                            sweeps),
+            ),)
+
         def sel(new, old):
             return jnp.where(active, new, old)
 
@@ -394,29 +416,33 @@ def _pr_phase_tiled(carry, eps, *, C, Uem, U2, sup2, cap2, total,
             sel(exc_e2, exc_e), sel(exc_m2, exc_m), sel(exc_t2, exc_t),
             sel(pe3, pe), sel(pm3, pm), sel(pt3, pt),
             it + active.astype(jnp.int32), bf + sweeps, gu_state_new,
-        )
+        ) + telem_out
 
     init = (F, Ffb, Fmt, exc_e, exc_m, exc_t, pe, pm, pt,
             jnp.int32(0), jnp.int32(0),
             (jnp.int32(0), jnp.asarray(global_every, jnp.int32),
              jnp.int32(0)))
+    if telem_cap:
+        init = init + (ring_in,)
     (F, Ffb, Fmt, _ee, _em, _et, pe, pm, pt, iters, bf,
-     _gu) = lax.while_loop(cond, body, init)
-    return (
-        F, Ffb, Fmt, pe, pm, pt, total_iters + iters, total_bf + bf
-    ), iters
+     _gu, *t_out) = lax.while_loop(cond, body, init)
+    out = (F, Ffb, Fmt, pe, pm, pt, total_iters + iters, total_bf + bf)
+    if telem_cap:
+        out = out + (t_out[0],)
+    return out, iters
 
 
 @functools.partial(
-    jax.jit, static_argnames=("max_iter", "scale", "interpret")
+    jax.jit, static_argnames=("max_iter", "scale", "interpret", "telem_cap")
 )
 def solve_device_tiled(costs, supply, capacity, unsched_cost, arc_cap,
                        init_prices, init_flows, init_fb, eps_sched,
                        max_iter_total, global_every, bf_max,
                        adaptive_bf=0, *,
-                       max_iter, scale, interpret=False):
+                       max_iter, scale, interpret=False, telem_cap=0):
     """Drop-in twin of transport._solve_device with the iteration body as
-    one tiled kernel launch.  Same operand contract, same outputs,
+    one tiled kernel launch.  Same operand contract, same outputs
+    (plus the telemetry ring appended when ``telem_cap`` > 0),
     bit-identical results (interpret-mode parity tests).
 
     Operands re-pad here to kernel alignment (rows to 8 sublanes, lanes
@@ -462,12 +488,16 @@ def solve_device_tiled(costs, supply, capacity, unsched_cost, arc_cap,
         sup2=supply_k[:, None], cap2=cap_k[None, :], total=total,
         max_iter=max_iter, max_iter_total=max_iter_total,
         global_every=global_every, bf_max=bf_max, adaptive=adaptive_bf,
-        interpret=interpret,
+        interpret=interpret, telem_cap=telem_cap,
     )
     carry0 = (F0, Ffb0[:, None], Fmt0[None, :], pe[:, None], pm[None, :],
               pt.astype(jnp.int32), jnp.int32(0), jnp.int32(0))
-    (F, Ffb2, Fmt2, pe2, pm2, pt2, iters, bf), phase_iters = lax.scan(
-        phase, carry0, eps_sched
+    if telem_cap:
+        carry0 = carry0 + (
+            jnp.zeros((TELEM_ROWS, telem_cap), jnp.int32),
+        )
+    (F, Ffb2, Fmt2, pe2, pm2, pt2, iters, bf, *t_out), phase_iters = (
+        lax.scan(phase, carry0, eps_sched)
     )
     prices = jnp.concatenate(
         [pe2[:E, 0], pm2[0, :M], pt2[None]]
@@ -478,6 +508,9 @@ def solve_device_tiled(costs, supply, capacity, unsched_cost, arc_cap,
     exc_m = jnp.sum(F, axis=0, keepdims=True) - Fmt2
     exc_t = jnp.sum(Fmt2) + jnp.sum(Ffb2) - total
     clean = jnp.all(exc_e == 0) & jnp.all(exc_m == 0) & (exc_t == 0)
-    return (
+    result = (
         F[:E, :M], Ffb2[:E, 0], prices, iters, bf, clean, phase_iters
     )
+    if telem_cap:
+        result = result + (t_out[0],)
+    return result

@@ -77,11 +77,13 @@ def flight_timeline(path: str, round_index: Optional[int] = None,
     if record is None:
         raise ValueError(f"{path}: no recorded rounds to render")
     spans = record.get("spans") or []
-    obj = chrome_trace(spans)
+    counters = record.get("counters") or []
+    obj = chrome_trace(spans, counters)
     obj["flightMeta"] = {
         "trace": os.path.basename(path),
         "round": round_index,
         "spans": len(spans),
+        "counters": len(counters),
     }
     if out_path is not None:
         d = os.path.dirname(out_path)

@@ -27,6 +27,7 @@ from poseidon_tpu.costmodel import get_cost_model
 from poseidon_tpu.graph.instance import RoundPlanner
 from poseidon_tpu.graph.state import ClusterState
 from poseidon_tpu.obs import metrics as obs_metrics
+from poseidon_tpu.obs import profile as obs_profile
 from poseidon_tpu.protos import firmament_pb2 as fpb
 from poseidon_tpu.protos.services import (
     FIRMAMENT_METHODS,
@@ -175,9 +176,13 @@ class FirmamentServicer:
             metrics.iterations, metrics.bf_sweeps, metrics.device_calls,
         )
         # Prometheus feed: every RoundMetrics field (schema-driven via
-        # to_dict) plus the process-wide compile-ledger counters.
+        # to_dict) plus the process-wide compile-ledger counters and —
+        # round boundaries being the sampling cadence — the per-device
+        # memory gauges (obs/profile.py: HBM in use / peak / limit per
+        # device, live-buffer count).
         obs_metrics.observe_round(metrics)
         obs_metrics.observe_ledger()
+        obs_profile.observe_device_memory()
         every = self.config.checkpoint_every_rounds
         if (
             self.config.checkpoint_path and every > 0

@@ -145,6 +145,20 @@ HATCHES: Tuple[Hatch, ...] = (
           "obs/trace.py)"),
     Hatch("POSEIDON_STAGE_TIMERS", "bool_off", "0",
           "Aggregate per-stage wall timings without span recording"),
+    Hatch("POSEIDON_SOLVE_TELEMETRY", "bool_on", "1",
+          "On-device convergence telemetry: a bounded per-iteration "
+          "sample ring inside the solver kernels, fetched in the "
+          "existing host_fetch batch; 0 restores today's iterate "
+          "bit-for-bit"),
+    Hatch("POSEIDON_SOLVE_TELEMETRY_CAP", "int", "512",
+          "Convergence-telemetry ring capacity in samples (rounded up "
+          "to a lane multiple of 128; static per compile key)"),
+    Hatch("POSEIDON_JAX_PROFILE", "str", "",
+          "Directory for jax.profiler.trace captures around each "
+          "round's solve window (obs/profile.py; empty = off)"),
+    Hatch("POSEIDON_ROUND_HISTORY", "int", "128",
+          "Round-history ring capacity behind the /debug/rounds "
+          "introspection endpoints (obs/history.py)"),
     Hatch("POSEIDON_REPLAY_PROGRESS", "flag", "",
           "Per-round progress breadcrumbs on stderr during replay"),
     # ------------------------------------------------------- process plumbing

@@ -349,7 +349,8 @@ def test_metrics_server_serves_exposition():
             assert resp.headers["Content-Type"] == obs_metrics.CONTENT_TYPE
         assert "poseidon_up_total 1" in body
         with urllib.request.urlopen(f"{base}/healthz", timeout=5) as resp:
-            assert resp.read() == b"ok\n"
+            report = json.loads(resp.read())
+            assert report["ok"] is True
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(f"{base}/nope", timeout=5)
     finally:
@@ -408,7 +409,7 @@ def test_firmament_server_serves_metrics():
         assert server.metrics_server is not None
         base = f"http://{server.metrics_server.address}"
         with urllib.request.urlopen(f"{base}/healthz", timeout=5) as resp:
-            assert resp.read() == b"ok\n"
+            assert json.loads(resp.read())["ok"] is True
         with urllib.request.urlopen(f"{base}/metrics", timeout=5) as resp:
             assert resp.status == 200
     # Context exit stopped the exporter with the gRPC server.
@@ -706,3 +707,239 @@ def test_trace_smoke_validators():
     probs2 = []
     trace_smoke.validate_round_decomposition(orphan, probs2)
     assert probs2
+
+
+# ------------------------------------------------- counter tracks (PR 13)
+
+
+def test_counter_series_exports_and_validates(monkeypatch, tmp_path):
+    monkeypatch.setenv(obs_trace.TRACE_ENV, "1")
+    tracer = obs_trace.tracer()
+    t0 = tracer._epoch + 1.0
+    with obs_trace.span("round"):
+        obs_trace.counter_series(
+            "conv.active_excess", t0, t0 + 0.5, [100, 50, 25, 0]
+        )
+        obs_trace.counter("conv.active_rows", 7, ts=t0 + 0.1)
+    obj = obs_trace.export_chrome_trace(str(tmp_path / "t.json"))
+    assert obs_trace.validate_chrome_trace(obj) == []
+    tracks = obs_trace.counter_tracks(obj)
+    assert tracks["conv.active_excess"] == 4
+    assert tracks["conv.active_rows"] == 1
+    # samples land inside the window, evenly spread, values intact
+    c_events = [e for e in obj["traceEvents"]
+                if e.get("ph") == "C" and e["name"] == "conv.active_excess"]
+    assert [e["args"]["value"] for e in c_events] == [100.0, 50.0, 25.0, 0.0]
+    ts = [e["ts"] for e in c_events]
+    assert ts == sorted(ts) and ts[-1] - ts[0] == pytest.approx(5e5, rel=0.01)
+
+
+def test_counter_recording_gated_on_tracing():
+    obs_trace.counter("conv.x", 1.0)
+    obs_trace.counter_series("conv.y", 0.0, 1.0, [1, 2])
+    assert obs_trace.counter_samples() == []
+
+
+def test_counter_validator_catches_malformed_events():
+    obj = {"traceEvents": [
+        {"name": "c", "ph": "C", "ts": 1, "pid": 1, "args": {"value": 1}},
+        {"ph": "C", "ts": 1, "pid": 1, "args": {"value": 1}},        # no name
+        {"name": "c", "ph": "C", "ts": 0.5, "pid": 1,
+         "args": {"value": 1}},                                      # float ts
+        {"name": "c", "ph": "C", "ts": 1, "pid": 1, "args": {}},     # empty
+        {"name": "c", "ph": "C", "ts": 1, "pid": 1,
+         "args": {"value": "hi"}},                                   # non-num
+    ]}
+    problems = obs_trace.validate_chrome_trace(obj)
+    assert len(problems) == 4
+
+
+def test_drain_counter_samples_clears_buffer(monkeypatch):
+    monkeypatch.setenv(obs_trace.TRACE_ENV, "1")
+    obs_trace.counter("conv.z", 3.0)
+    drained = obs_trace.drain_counter_samples()
+    assert [d["value"] for d in drained] == [3.0]
+    assert obs_trace.counter_samples() == []
+
+
+def test_flight_timeline_carries_counters(tmp_path):
+    from poseidon_tpu.chaos.plan import named_plan
+    from poseidon_tpu.chaos.recorder import FlightRecorder
+    from poseidon_tpu.replay.flight import flight_timeline
+
+    plan = named_plan("smoke", 2, seed=0)
+    recorder = FlightRecorder({"name": "smoke", "seed": 0},
+                              plan, out_dir=str(tmp_path))
+    spans = [{"name": "round", "ts": 0.0, "dur": 0.5, "tid": 1,
+              "tname": "MainThread", "id": 1, "parent": None, "attrs": {}}]
+    counters = [{"name": "conv.active_excess", "ts": 0.1, "value": 42.0}]
+    recorder.record_round(0, faults=[], deltas=[], metrics={},
+                          digest="d0", placements=1, spans=spans,
+                          counters=counters)
+    path = recorder.record_failure(0, "divergence", "boom")
+    obj = flight_timeline(path)
+    assert obs_trace.validate_chrome_trace(obj) == []
+    assert obs_trace.counter_tracks(obj) == {"conv.active_excess": 1}
+    assert obj["flightMeta"]["counters"] == 1
+
+
+# ------------------------------------------- healthz + /debug introspection
+
+
+def test_healthz_liveness_report(monkeypatch):
+    from poseidon_tpu.glue.poseidon import LoopStats
+    from poseidon_tpu.obs.history import default_history
+
+    obs_metrics._reset_health()
+    # The idle report must not fall back to rounds an earlier test's
+    # planner recorded into the process-global history ring.
+    default_history().clear()
+    reg = obs_metrics.Registry()
+    server = obs_metrics.MetricsServer("127.0.0.1:0", registry=reg).start()
+    try:
+        base = f"http://{server.address}"
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as resp:
+            idle = json.loads(resp.read())
+        assert idle["ok"] is True and idle["last_round_age_s"] is None
+
+        from poseidon_tpu.graph.instance import RoundMetrics
+
+        obs_metrics.observe_round(RoundMetrics(round_index=5), registry=reg)
+        stats = LoopStats(rounds=2, consecutive_failures=1)
+        obs_metrics.observe_loop(stats, resyncs=3, crash_loop_budget=4,
+                                 registry=reg)
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as resp:
+            live = json.loads(resp.read())
+        assert live["last_round_index"] == 5
+        assert live["last_round_age_s"] is not None
+        assert live["consecutive_failures"] == 1
+        assert live["crash_loop_budget"] == 4
+        assert live["resyncs"] == 3
+
+        # A fatal loop stop fails liveness with 503.
+        obs_metrics.observe_loop(stats, resyncs=3, crash_loop_budget=4,
+                                 fatal=True, registry=reg)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/healthz", timeout=5)
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["loop_fatal"] is True
+    finally:
+        server.stop()
+        obs_metrics._reset_health()
+
+
+def test_debug_round_history_endpoints():
+    from poseidon_tpu.obs.history import RoundHistory
+
+    hist = RoundHistory(capacity=2)
+    hist.record({"round_index": 0, "solve_tier": "dense", "placed": 3},
+                curves=[{"band": 0, "samples": 10}])
+    hist.record({"round_index": 1, "solve_tier": "quiet"})
+    hist.record({"round_index": 2, "solve_tier": "pruned"})  # evicts 0
+    server = obs_metrics.MetricsServer(
+        "127.0.0.1:0", registry=obs_metrics.Registry(), history=hist,
+    ).start()
+    try:
+        base = f"http://{server.address}"
+        with urllib.request.urlopen(f"{base}/debug/rounds", timeout=5) as r:
+            listing = json.loads(r.read())
+        assert listing["capacity"] == 2 and listing["retained"] == 2
+        assert [s["round"] for s in listing["rounds"]] == [1, 2]
+        with urllib.request.urlopen(f"{base}/debug/round/2", timeout=5) as r:
+            rec = json.loads(r.read())
+        assert rec["metrics"]["solve_tier"] == "pruned"
+        # Evicted and never-recorded rounds 404 with the retained range.
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/debug/round/0", timeout=5)
+        assert exc.value.code == 404
+        assert json.loads(exc.value.read())["retained_range"] == [1, 2]
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/debug/round/xyz", timeout=5)
+        assert exc.value.code == 400
+        # /healthz on the SAME server consults the SAME history ring
+        # (its idle fallback must not read the process-global default —
+        # the two endpoints would disagree about liveness).
+        obs_metrics._reset_health()
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as r:
+            health = json.loads(r.read())
+        assert health["last_round_index"] == 2
+        assert health["last_round_age_s"] is not None
+    finally:
+        server.stop()
+        obs_metrics._reset_health()
+
+
+def test_round_history_ring_and_summaries():
+    from poseidon_tpu.obs.history import RoundHistory
+
+    hist = RoundHistory(capacity=3)
+    for i in range(5):
+        hist.record({"round_index": i, "placed": i * 10,
+                     "telem_samples": i})
+    assert len(hist) == 3
+    assert hist.retained_range() == (2, 4)
+    assert hist.get(0) is None
+    rec = hist.get(4)
+    assert rec["metrics"]["placed"] == 40 and rec["age_s"] >= 0
+    tops = hist.summaries()
+    assert [s["round"] for s in tops] == [2, 3, 4]
+    assert all("age_s" in s for s in tops)
+    # capacity 0 disables recording entirely
+    off = RoundHistory(capacity=0)
+    off.record({"round_index": 1})
+    assert len(off) == 0
+
+
+# ------------------------------------- telemetry fields on the wire format
+
+
+def test_observe_round_tolerates_schema_unknown_keys():
+    reg = obs_metrics.Registry()
+    d = {
+        "round_index": 1, "solve_tier": "dense", "placed": 2,
+        "schema": 1,
+        "future_numeric": 17,          # unknown numeric -> gauge anyway
+        "future_text": "whatever",     # unknown non-numeric -> skipped
+        "future_list": [1, 2, 3],      # lists never become gauges
+    }
+    obs_metrics.observe_round(d, registry=reg)
+    text = reg.expose()
+    assert "poseidon_round_future_numeric 17" in text
+    assert "future_text" not in text
+    assert "future_list" not in text
+    assert "poseidon_round_placed 2" in text
+
+
+def test_telemetry_fields_ride_wire_exporter_and_recorder(tmp_path):
+    from poseidon_tpu.chaos.plan import named_plan
+    from poseidon_tpu.chaos.recorder import FlightRecorder
+    from poseidon_tpu.graph.instance import RoundMetrics
+
+    m = RoundMetrics(round_index=4, telem_samples=120, telem_gu_firings=30,
+                     telem_decay_half_life=12.5, telem_iters_to_90=88)
+    d = m.to_dict()
+    # wire round-trip
+    m2 = RoundMetrics.from_dict(json.loads(json.dumps(d)))
+    assert m2 == m
+    # exporter: the schema walk turns every telem scalar into a gauge
+    reg = obs_metrics.Registry()
+    obs_metrics.observe_round(m, registry=reg)
+    text = reg.expose()
+    assert "poseidon_round_telem_samples 120" in text
+    assert "poseidon_round_telem_gu_firings 30" in text
+    assert "poseidon_round_telem_decay_half_life 12.5" in text
+    assert "poseidon_round_telem_iters_to_90 88" in text
+    # flight recorder: the dict lands verbatim in the round record
+    plan = named_plan("smoke", 1, seed=0)
+    recorder = FlightRecorder({"name": "smoke", "seed": 0},
+                              plan, out_dir=str(tmp_path))
+    recorder.record_round(4, faults=[], deltas=[], metrics=d,
+                          digest="dd", placements=0)
+    path = recorder.record_failure(4, "kind", "detail")
+    from poseidon_tpu.replay.flight import load_flight
+
+    trace = load_flight(path)
+    got = trace.rounds[-1]["metrics"]
+    assert got["telem_samples"] == 120
+    assert got["telem_iters_to_90"] == 88
+    assert RoundMetrics.from_dict(got) == m

@@ -1,0 +1,281 @@
+"""On-device convergence telemetry (PR 13): the per-iteration sample
+ring threaded through the lax/fused/tiled/sharded kernels.
+
+Contracts pinned here:
+
+- telemetry-OFF reproduces today's iterate bit-for-bit (the ring never
+  feeds back; with the cap at 0 the traced program is the historical
+  one);
+- the ring is bit-identical across the lax, fused, and tiled kernels
+  (the arithmetic is shared, so the sampled excess sequence must be
+  too);
+- decode semantics: full curves under the cap, last-cap-samples with
+  correct ordering when the ring wraps, per-sample bf sweeps summing to
+  the solve's total;
+- the sharded path carries per-shard machine-side excess lanes and
+  still fetches everything in ONE host_fetch batch
+  (TransferLedger(budget=0) holds with telemetry on);
+- the planner rolls curves into RoundMetrics and the digest is
+  JSON-safe.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poseidon_tpu.ops import transport as T
+from poseidon_tpu.ops.transport import (
+    INF_COST,
+    TELEM_ROWS,
+    _POS,
+    SolveTelemetry,
+    _host_validate,
+    _solve_device,
+    decode_telemetry,
+    solve_telemetry_cap,
+    solve_transport,
+)
+
+
+def _instance(seed, E, M, max_cost=1000, cap_hi=4):
+    rng = np.random.default_rng(seed)
+    costs = rng.integers(0, max_cost, size=(E, M)).astype(np.int32)
+    costs[rng.random((E, M)) < 0.1] = INF_COST
+    supply = rng.integers(1, 8, size=E).astype(np.int32)
+    capacity = rng.integers(1, cap_hi, size=M).astype(np.int32)
+    unsched = rng.integers(max_cost, 2 * max_cost, size=E).astype(np.int32)
+    return costs, supply, capacity, unsched
+
+
+def _device_args(costs, supply, capacity, unsched):
+    E, M = costs.shape
+    arc_cap = np.full((E, M), _POS, dtype=np.int32)
+    prices = np.zeros(E + M + 1, dtype=np.int32)
+    flows = np.zeros((E, M), dtype=np.int32)
+    fb = np.zeros(E, dtype=np.int32)
+    scale, eps_sched, _ = _host_validate(
+        costs, supply, capacity, unsched, None, None
+    )
+    return (
+        (costs, supply, capacity, unsched, arc_cap, prices, flows, fb,
+         jnp.asarray(eps_sched), jnp.int32(32768), jnp.int32(4),
+         jnp.int32(64), jnp.int32(0)),
+        int(scale),
+    )
+
+
+# ------------------------------------------------------------ cap semantics
+
+
+def test_cap_hatch_semantics(monkeypatch):
+    monkeypatch.delenv("POSEIDON_SOLVE_TELEMETRY", raising=False)
+    monkeypatch.delenv("POSEIDON_SOLVE_TELEMETRY_CAP", raising=False)
+    assert solve_telemetry_cap() == 512          # default on, lane-aligned
+    monkeypatch.setenv("POSEIDON_SOLVE_TELEMETRY_CAP", "100")
+    assert solve_telemetry_cap() == 128          # rounded up to 128
+    monkeypatch.setenv("POSEIDON_SOLVE_TELEMETRY_CAP", "0")
+    assert solve_telemetry_cap() == 0
+    monkeypatch.setenv("POSEIDON_SOLVE_TELEMETRY_CAP", "512")
+    monkeypatch.setenv("POSEIDON_SOLVE_TELEMETRY", "0")
+    assert solve_telemetry_cap() == 0            # master switch wins
+
+
+# ------------------------------------------------- off-path bit-identity
+
+
+def test_telemetry_off_is_bit_identical(monkeypatch):
+    costs, supply, capacity, unsched = _instance(1, 16, 96)
+    monkeypatch.delenv("POSEIDON_SOLVE_TELEMETRY", raising=False)
+    on = solve_transport(costs, supply, capacity, unsched)
+    monkeypatch.setenv("POSEIDON_SOLVE_TELEMETRY", "0")
+    off = solve_transport(costs, supply, capacity, unsched)
+    assert off.telemetry is None
+    assert on.objective == off.objective
+    assert on.iterations == off.iterations
+    assert on.bf_sweeps == off.bf_sweeps
+    np.testing.assert_array_equal(on.flows, off.flows)
+    np.testing.assert_array_equal(on.unsched, off.unsched)
+    np.testing.assert_array_equal(on.prices, off.prices)
+
+
+def test_seven_tuple_contract_preserved_without_cap():
+    costs, supply, capacity, unsched = _instance(2, 8, 64)
+    args, scale = _device_args(costs, supply, capacity, unsched)
+    out = _solve_device(*args, max_iter=4096, scale=scale)
+    assert len(out) == 7
+
+
+# -------------------------------------------------------- curve semantics
+
+
+def test_curve_decodes_full_solve():
+    costs, supply, capacity, unsched = _instance(3, 16, 96, cap_hi=2)
+    sol = solve_transport(costs, supply, capacity, unsched)
+    t = sol.telemetry
+    assert t is not None and sol.iterations > 0
+    assert t.samples() == min(sol.iterations, t.cap)
+    assert t.total_iters == sol.iterations
+    # Sample ordering: consecutive global iteration indices.
+    assert (np.diff(t.iters) == 1).all()
+    # Per-iteration BF sweeps sum to the solve's reported total (no
+    # wrap at this size), and every global-update firing carried
+    # sweeps >= 0 while non-firing iterations carried none.
+    assert int(t.bf_sweeps.sum()) == sol.bf_sweeps
+    assert t.gu_firings() >= 1
+    assert (t.bf_sweeps[t.gu_fired == 0] == 0).all()
+    # The first iteration of a cold contended solve has active excess.
+    assert int(t.active_excess[0]) > 0
+    assert (t.active_rows >= 0).all() and (t.active_cols >= 0).all()
+    # eps rungs are drawn from the (descending) ladder.
+    assert set(np.unique(t.eps)) <= set(
+        T.eps_schedule(int(t.eps.max())).tolist()
+    ) | {int(t.eps.max()), 1}
+
+
+def test_ring_wrap_keeps_last_cap_samples(monkeypatch):
+    monkeypatch.setenv("POSEIDON_SOLVE_TELEMETRY_CAP", "128")
+    costs, supply, capacity, unsched = _instance(4, 48, 256, cap_hi=2)
+    sol = solve_transport(costs, supply, capacity, unsched,
+                          greedy_init=False)
+    t = sol.telemetry
+    assert t is not None
+    if sol.iterations <= t.cap:
+        pytest.skip(f"solve too short to wrap ({sol.iterations} iters)")
+    assert t.cap == 128
+    assert t.wrapped() and t.samples() == 128
+    # The decoded window is the LAST cap iterations, oldest first.
+    assert int(t.iters[-1]) == sol.iterations - 1
+    assert (np.diff(t.iters) == 1).all()
+
+
+def test_decode_telemetry_unit():
+    cap = 8
+    ring = np.zeros((TELEM_ROWS, cap), dtype=np.int32)
+    # Simulate 11 iterations: slot = it % 8.
+    for it in range(11):
+        ring[T._TR_ITER, it % cap] = it
+        ring[T._TR_EXCESS, it % cap] = 100 - it
+    t = decode_telemetry(ring, 11)
+    assert t.samples() == 8 and t.wrapped()
+    assert list(t.iters) == list(range(3, 11))
+    assert list(t.active_excess) == [100 - i for i in range(3, 11)]
+    # Under-full ring decodes only the written prefix (fresh ring: the
+    # wrap simulation above already overwrote the early slots).
+    ring5 = np.zeros((TELEM_ROWS, cap), dtype=np.int32)
+    for it in range(5):
+        ring5[T._TR_ITER, it] = it
+    t2 = decode_telemetry(ring5, 5)
+    assert list(t2.iters) == list(range(5))
+    assert decode_telemetry(ring, 0) is None
+    assert decode_telemetry(np.zeros((TELEM_ROWS, 0), np.int32), 5) is None
+
+
+def test_half_life_and_drain_metrics():
+    n = 10
+    t = SolveTelemetry(
+        iters=np.arange(n),
+        active_excess=np.array([100, 90, 55, 49, 30, 20, 11, 9, 4, 0]),
+        active_rows=np.ones(n, np.int32),
+        active_cols=np.ones(n, np.int32),
+        eps=np.full(n, 7, np.int32),
+        gu_fired=np.zeros(n, np.int32),
+        bf_sweeps=np.zeros(n, np.int32),
+        total_iters=n, cap=512,
+    )
+    assert t.decay_half_life() == 3.0    # first sample <= 50 is index 3
+    assert t.iters_to_drain(0.9) == 7    # first sample <= ~10 is index 7
+    d = t.digest(max_points=4)
+    json.dumps(d)                        # JSON-safe by contract
+    assert d["samples"] == n and d["iters"][-1] == n - 1
+    assert d["decay_half_life"] == 3.0 and d["iters_to_90"] == 7
+    assert len(d["iters"]) <= 5          # stride + forced last point
+
+
+# ------------------------------------------------------- kernel bit-parity
+
+
+def test_ring_bit_identical_across_kernels():
+    from poseidon_tpu.ops.transport_fused import solve_device_fused
+    from poseidon_tpu.ops.transport_tiled import solve_device_tiled
+
+    costs, supply, capacity, unsched = _instance(5, 16, 128, cap_hi=2)
+    args, scale = _device_args(costs, supply, capacity, unsched)
+    lax_out = _solve_device(*args, max_iter=8192, scale=scale,
+                            telem_cap=256)
+    fused_out = solve_device_fused(*args, max_iter=8192, scale=scale,
+                                   interpret=True, telem_cap=256)
+    tiled_out = solve_device_tiled(*args, max_iter=8192, scale=scale,
+                                   interpret=True, telem_cap=256)
+    ring_lax = np.asarray(lax_out[7])
+    assert int(lax_out[3]) > 0
+    np.testing.assert_array_equal(ring_lax, np.asarray(fused_out[7]))
+    np.testing.assert_array_equal(ring_lax, np.asarray(tiled_out[7]))
+    # And the ring really sampled the solve.
+    t = decode_telemetry(ring_lax, int(lax_out[3]))
+    assert t is not None and t.samples() == min(int(lax_out[3]), 256)
+
+
+# ----------------------------------------------------------- sharded lanes
+
+
+def test_sharded_per_shard_lanes_and_single_fetch():
+    import jax
+
+    from poseidon_tpu.check.ledger import TransferLedger
+    from poseidon_tpu.ops.transport_sharded import (
+        make_solver_mesh,
+        solve_transport_sharded,
+    )
+
+    assert len(jax.devices()) >= 8
+    mesh = make_solver_mesh(8)
+    costs, supply, capacity, unsched = _instance(6, 12, 48, cap_hi=2)
+    with TransferLedger(budget=0, label="sharded telemetry solve"):
+        sol = solve_transport_sharded(
+            costs, supply, capacity, unsched, mesh=mesh,
+        )
+    single = solve_transport(costs, supply, capacity, unsched)
+    assert sol.objective == single.objective
+    t = sol.telemetry
+    if sol.iterations == 0:
+        pytest.skip("instance certified without a device ladder")
+    assert t is not None
+    assert t.shard_excess is not None and t.shard_excess.shape[0] == 8
+    # Shard lanes decompose the machine-side active excess: each lane
+    # is non-negative and their per-iteration sum is bounded by the
+    # total active excess sample (EC-side excess adds on top).
+    assert (t.shard_excess >= 0).all()
+    assert (t.shard_excess.sum(axis=0) <= t.active_excess).all()
+    json.dumps(t.digest())  # shard lanes ride the digest JSON-safely
+
+
+# -------------------------------------------------------- planner roll-up
+
+
+def test_planner_rolls_curves_into_round_metrics():
+    from bench import contended_cluster
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+
+    # The shared contention recipe (more demand than comfortable
+    # capacity) — the solve runs real iterations and captures a curve.
+    state = contended_cluster(prefix="tj")
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+    _, metrics = planner.schedule_round()
+    if metrics.iterations == 0:
+        pytest.skip("instance certified without device iterations")
+    assert metrics.telem_samples > 0
+    assert metrics.telem_iters_to_90 >= 0
+    assert planner.last_solve_curves
+    d = planner.last_solve_curves[0]
+    json.dumps(planner.last_solve_curves)
+    assert d["samples"] > 0 and "band" in d
+    # The wire format carries the roll-ups end to end.
+    from poseidon_tpu.graph.instance import RoundMetrics
+
+    m2 = RoundMetrics.from_dict(json.loads(json.dumps(metrics.to_dict())))
+    assert m2.telem_samples == metrics.telem_samples

@@ -155,6 +155,27 @@ def collect_counts(art: dict) -> Dict[str, Tuple[float, float, float]]:
     return out
 
 
+# The machine-independent per-round device series printed (not gated)
+# in the human-readable summary: an A/B session reads the deltas at a
+# glance instead of digging both artifacts out of the gate's pass/fail.
+_DEVICE_SERIES = (
+    "wave_solve_iters", "wave_bf_sweeps", "wave_device_calls",
+    "wave_entry_phase", "churn_solve_iters", "churn_device_calls",
+)
+
+
+def collect_device_series(art: dict) -> Dict[str, List[float]]:
+    """The per-round device-work lists present in an artifact."""
+    out: Dict[str, List[float]] = {}
+    for key in _DEVICE_SERIES:
+        val = art.get(key)
+        if isinstance(val, list) and val and all(
+            isinstance(v, (int, float)) for v in val
+        ):
+            out[key] = [float(v) for v in val]
+    return out
+
+
 def compare(
     baseline: dict,
     current: dict,
@@ -220,6 +241,13 @@ def compare(
     return {
         "comparable": True, "reason": None, "rows": rows,
         "skipped": sorted(skipped), "regressions": regressions,
+        # Raw per-round device series (printed, not gated — the gate
+        # already judges their SUMS above; the round-by-round shape is
+        # what a live A/B session wants to eyeball).
+        "device_series": {
+            "baseline": collect_device_series(baseline),
+            "current": collect_device_series(current),
+        },
     }
 
 
@@ -240,6 +268,30 @@ def render(result: dict, baseline_path: str, current_path: str) -> str:
     for name in result["skipped"]:
         lines.append(f"  {name.ljust(width)}  (present on one side only; "
                      "skipped)")
+    # Per-round device-work series, human-readable (the PR 8 machine-
+    # independent counts: solve_iters / bf_sweeps / device_calls /
+    # entry_phase) — so an A/B session reads the round-by-round deltas
+    # at a glance, not just the gated sums.
+    ds = result.get("device_series") or {}
+    base_s, cur_s = ds.get("baseline", {}), ds.get("current", {})
+    names = sorted(set(base_s) | set(cur_s))
+    if names:
+        lines.append("  device series (per round, baseline -> current):")
+
+        def fmt(vals):
+            if vals is None:
+                return "-"
+            body = " ".join(
+                str(int(v)) if float(v).is_integer() else f"{v:.3g}"
+                for v in vals
+            )
+            return f"[{body}] sum={int(sum(vals))}"
+
+        for name in names:
+            lines.append(
+                f"    {name}: {fmt(base_s.get(name))} -> "
+                f"{fmt(cur_s.get(name))}"
+            )
     n = len(result["regressions"])
     lines.append(
         f"  => {n} regression(s)" if n else "  => no regressions"

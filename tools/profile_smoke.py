@@ -1,0 +1,193 @@
+"""Profile smoke (``make profile-smoke``): the solver-introspection
+layer end to end on one CPU-pinned process.
+
+Drives a contended round with convergence telemetry ON and fails
+unless:
+
+- the round captured per-band convergence curves: RoundMetrics carries
+  the roll-ups (``telem_samples`` / ``telem_iters_to_90``), the curve
+  digests are JSON-safe with monotone iteration indices and a
+  non-increasing tail, and the artifact lands in
+  ``out/profile_smoke.json``;
+- the hatch-gated ``jax.profiler.trace`` window captured an XLA
+  profile under ``out/profile_smoke_jax/round_*`` (POSEIDON_JAX_PROFILE
+  wired through ``obs/profile.solve_profile``);
+- a live ``MetricsServer`` answers the introspection endpoints:
+  ``/debug/rounds`` lists the recorded rounds, ``/debug/round/<n>``
+  returns the full record with curves, ``/healthz`` reports JSON
+  liveness with a last-round age;
+- a WARM instrumented round holds BOTH ``CompileLedger(budget=0)`` and
+  ``TransferLedger(budget=0)`` — the telemetry ring rides the existing
+  single host_fetch batch, so instrumentation adds zero fresh compiles
+  and zero extra transfer slots to the steady state.
+
+CPU-pinned: a smoke gate must never contend for (or wedge on) the
+accelerator tunnel.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT_PATH = os.path.join("out", "profile_smoke.json")
+PROFILE_DIR = os.path.join("out", "profile_smoke_jax")
+
+
+def _validate_curves(curves, problems):
+    for c in curves:
+        try:
+            json.dumps(c)
+        except (TypeError, ValueError) as e:
+            problems.append(f"curve digest not JSON-safe: {e}")
+            continue
+        if c["samples"] <= 0:
+            problems.append(f"band {c.get('band')}: empty curve digest")
+            continue
+        iters = c["iters"]
+        if any(b <= a for a, b in zip(iters, iters[1:])):
+            problems.append(
+                f"band {c.get('band')}: iteration indices not "
+                f"strictly increasing: {iters[:8]}..."
+            )
+        if any(v < 0 for v in c["active_excess"]):
+            problems.append(
+                f"band {c.get('band')}: negative active excess"
+            )
+        if c["iters_to_90"] < 0 or c["decay_half_life"] < 0:
+            problems.append(
+                f"band {c.get('band')}: negative drain/half-life"
+            )
+
+
+def main() -> int:
+    # CPU pin BEFORE jax loads a backend (same recipe as trace_smoke).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["POSEIDON_SOLVE_TELEMETRY"] = "1"
+    shutil.rmtree(PROFILE_DIR, ignore_errors=True)
+    os.environ["POSEIDON_JAX_PROFILE"] = PROFILE_DIR
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from bench import contended_cluster
+    from poseidon_tpu.check.ledger import CompileLedger, TransferLedger
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+    from poseidon_tpu.obs import metrics as obs_metrics
+    from poseidon_tpu.obs.history import default_history
+
+    problems: list = []
+    default_history().clear()
+
+    # Shared contention recipe (bench.contended_cluster): the solve
+    # cannot host-certify, so the telemetry ring captures a curve.
+    state = contended_cluster(prefix="ps")
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+    _, m_cold = planner.schedule_round()   # cold: compiles land here
+    if m_cold.iterations == 0:
+        problems.append("contended cold round solved in 0 iterations — "
+                        "nothing exercised the telemetry ring")
+    if m_cold.telem_samples == 0:
+        problems.append("cold round captured no telemetry samples "
+                        f"(iters={m_cold.iterations})")
+    curves = list(planner.last_solve_curves)
+    _validate_curves(curves, problems)
+
+    # jax profiler capture: the solve window of the cold round should
+    # have produced an artifact directory with at least one file.
+    cap_dir = os.path.join(PROFILE_DIR, f"round_{m_cold.round_index:06d}")
+    captured = [
+        os.path.join(r, f)
+        for r, _, fs in os.walk(cap_dir) for f in fs
+    ]
+    if not captured:
+        problems.append(
+            f"no jax profiler artifact under {cap_dir} "
+            "(POSEIDON_JAX_PROFILE window never captured)"
+        )
+
+    # Warm instrumented round under BOTH budget-0 ledgers: re-place a
+    # slice of the population (same shapes -> same compile keys) so the
+    # round does real work without minting compiles, and the telemetry
+    # fetch must add no transfer slots.
+    uids = sorted(state.tasks.keys())[: len(state.tasks) // 10]
+    from poseidon_tpu.graph.state import TaskInfo
+    from poseidon_tpu.utils.ids import task_uid
+
+    for uid in uids:
+        state.task_removed(uid)
+    for i, _uid in enumerate(uids):
+        state.task_submitted(TaskInfo(
+            uid=task_uid("ps-warm", i), job_id="ps-0",
+            cpu_request=300, ram_request=1 << 18,
+        ))
+    with CompileLedger(budget=0, label="profile-smoke warm round"), \
+            TransferLedger(budget=0, label="profile-smoke warm round"):
+        _, m_warm = planner.schedule_round()
+
+    # Introspection endpoints on a live exporter (the planner recorded
+    # both rounds into the default history ring).
+    server = obs_metrics.MetricsServer("127.0.0.1:0").start()
+    try:
+        base = f"http://{server.address}"
+        with urllib.request.urlopen(f"{base}/debug/rounds", timeout=5) as r:
+            listing = json.loads(r.read())
+        rounds = [s["round"] for s in listing["rounds"]]
+        if m_cold.round_index not in rounds or \
+                m_warm.round_index not in rounds:
+            problems.append(
+                f"/debug/rounds missing recorded rounds: got {rounds}"
+            )
+        url = f"{base}/debug/round/{m_cold.round_index}"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            rec = json.loads(r.read())
+        if len(rec.get("curves", [])) != len(curves):
+            problems.append(
+                f"/debug/round/{m_cold.round_index} carries "
+                f"{len(rec.get('curves', []))} curves, planner produced "
+                f"{len(curves)}"
+            )
+        if rec["metrics"].get("telem_samples") != m_cold.telem_samples:
+            problems.append("/debug round record disagrees with "
+                            "RoundMetrics.telem_samples")
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as r:
+            health = json.loads(r.read())
+        if not health.get("ok") or health.get("last_round_age_s") is None:
+            problems.append(f"/healthz liveness report wrong: {health}")
+    finally:
+        server.stop()
+
+    os.makedirs("out", exist_ok=True)
+    with open(OUT_PATH, "w", encoding="utf-8") as fh:
+        json.dump({
+            "cold": m_cold.to_dict(),
+            "warm": m_warm.to_dict(),
+            "curves": curves,
+            "profiler_files": len(captured),
+        }, fh)
+        fh.write("\n")
+
+    print(f"profile-smoke: cold iters={m_cold.iterations} "
+          f"samples={m_cold.telem_samples} "
+          f"iters_to_90={m_cold.telem_iters_to_90} "
+          f"half_life={m_cold.telem_decay_half_life} "
+          f"curves={len(curves)}; warm iters={m_warm.iterations} "
+          f"(budget-0 ledgers held); profiler files={len(captured)} "
+          f"-> {OUT_PATH}")
+    if problems:
+        for prob in problems:
+            print(f"profile-smoke: FAIL {prob}", file=sys.stderr)
+        return 1
+    print("profile-smoke: telemetry curves valid, /debug + /healthz "
+          "served, CompileLedger+TransferLedger budget-0 held warm")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

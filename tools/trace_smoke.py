@@ -19,7 +19,14 @@ artifact to ``out/trace_smoke.json``, and fails unless:
   land on a worker lane, cross-thread-parented to the round span, its
   interval overlapping the first band's ``round.solve_band`` — and the
   exported artifact (which now contains cross-LANE overlap) must still
-  validate, proving the validator's lane-aware nesting rules.
+  validate, proving the validator's lane-aware nesting rules;
+- a third, CONTENDED round (bench.contended_cluster — demand past
+  comfortable capacity, so the solve really iterates), exported to its
+  OWN artifact (``out/trace_smoke_conv.json`` — the pipelined window 2
+  keeps ``out/trace_smoke.json``), must render at least one ``conv.*``
+  Perfetto COUNTER track: the solver's convergence-telemetry curves
+  laid onto the timeline (obs/trace counter events, validated by
+  ``validate_chrome_trace``).
 
 CPU-pinned: a smoke gate must never contend for (or wedge on) the
 accelerator tunnel.
@@ -39,6 +46,7 @@ STAGES = (
 )
 PARITY_TOLERANCE = 0.05
 OUT_PATH = os.path.join("out", "trace_smoke.json")
+CONV_OUT_PATH = os.path.join("out", "trace_smoke_conv.json")
 
 
 def validate_round_decomposition(spans, problems):
@@ -185,7 +193,7 @@ def main() -> int:
 
     jax.config.update("jax_platforms", "cpu")
 
-    from bench import build_cluster, submit_population
+    from bench import build_cluster, contended_cluster, submit_population
     from poseidon_tpu.costmodel import get_cost_model
     from poseidon_tpu.graph.instance import RoundPlanner
     from poseidon_tpu.obs import trace as obs_trace
@@ -224,18 +232,52 @@ def main() -> int:
     validate_round_decomposition(spans2, problems)
     validate_pipeline_overlap(spans2, metrics2, problems)
 
+    # Window 3: a CONTENDED round (demand past comfortable capacity, so
+    # the host certificate misses and the device ladder iterates) — the
+    # convergence-telemetry curves must render as Perfetto counter
+    # tracks next to the spans.  Exported to its OWN artifact: the
+    # committed cross-lane-overlap artifact (OUT_PATH, window 2) must
+    # survive for Perfetto inspection, not be overwritten here.
+    obs_trace.reset()
+    state3 = contended_cluster(prefix="ts3")
+    planner3 = RoundPlanner(state3, get_cost_model("cpu_mem"))
+    _, metrics3 = planner3.schedule_round()
+    obj3 = obs_trace.export_chrome_trace(CONV_OUT_PATH)
+    problems += obs_trace.validate_chrome_trace(obj3)
+    conv_tracks = {
+        k: v for k, v in obs_trace.counter_tracks(obj3).items()
+        if k.startswith("conv.")
+    }
+    if metrics3.iterations == 0:
+        problems.append(
+            "contended window solved in 0 iterations — the counter-"
+            "track assertion never exercised the telemetry path"
+        )
+    if not conv_tracks:
+        problems.append(
+            "no conv.* counter track rendered in the contended window "
+            f"(iters={metrics3.iterations}, "
+            f"telem_samples={metrics3.telem_samples})"
+        )
+    if metrics3.telem_samples and metrics3.iterations and \
+            metrics3.telem_samples != sum(
+                c["samples"] for c in planner3.last_solve_curves):
+        problems.append("telem_samples disagrees with the curve digests")
+
     n_events = sum(1 for e in obj2["traceEvents"] if e.get("ph") == "X")
     print(f"trace-smoke: round solve_tier={metrics.solve_tier} "
           f"placed={metrics.placed}; {len(spans)} spans; pipelined "
           f"round overlap={metrics2.pipeline_overlap_s}s "
-          f"delta_hits={metrics2.cost_delta_hits}; "
-          f"{n_events} events -> {OUT_PATH}")
+          f"delta_hits={metrics2.cost_delta_hits}, {n_events} events "
+          f"-> {OUT_PATH}; contended round iters={metrics3.iterations}, "
+          f"counter tracks {sorted(conv_tracks)} -> {CONV_OUT_PATH}")
     if problems:
         for prob in problems:
             print(f"trace-smoke: FAIL {prob}", file=sys.stderr)
         return 1
     print("trace-smoke: artifact valid (nesting incl. cross-lane "
-          "pipeline overlap, Perfetto format, stagetimer parity)")
+          "pipeline overlap, counter tracks, Perfetto format, "
+          "stagetimer parity)")
     return 0
 
 
