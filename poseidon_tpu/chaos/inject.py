@@ -34,6 +34,7 @@ import grpc
 
 from poseidon_tpu.chaos.plan import Fault, FaultPlan
 from poseidon_tpu.glue.fake_kube import KubeAPI
+from poseidon_tpu.utils.locks import TrackedLock
 
 log = logging.getLogger("poseidon.chaos")
 
@@ -76,7 +77,9 @@ class FaultInjector:
         self.fired: List[dict] = []
         # RLock: the record helper runs under the same lock the fault
         # accessors already hold.
-        self._lock = threading.RLock()
+        self._lock = TrackedLock(
+            "chaos.FaultInjector._lock", reentrant=True
+        )
         # Armed state, consumed as faults fire.
         self._disconnect: Dict[str, bool] = {}         # family key -> pending
         self._stall: Dict[str, int] = {}               # family key -> polls

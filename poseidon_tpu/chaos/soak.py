@@ -212,6 +212,11 @@ def run_soak(
     )
     from poseidon_tpu.glue.fake_kube import FakeKube, Node, Pod
     from poseidon_tpu.glue.poseidon import Poseidon
+    from poseidon_tpu.utils.locks import (
+        lock_contention_ns,
+        lock_order_edge_count,
+        lock_order_edges,
+    )
     from poseidon_tpu.ops.transport import bucket_size
     from poseidon_tpu.service.server import FirmamentTPUServer
     from poseidon_tpu.utils.config import (
@@ -235,7 +240,8 @@ def run_soak(
         "rounds_requested": rounds, "rounds_run": 0,
         "families_covered": list(fault_plan.families_covered()),
         "digests": [], "warm_fresh_compiles": 0,
-        "warm_implicit_transfers": 0, "tiers": [],
+        "warm_implicit_transfers": 0, "warm_lock_order_edges": [],
+        "lock_contention_ns": 0, "tiers": [],
         "divergent_rounds": 0, "cost_delta_hits": 0,
     }
     if expect_digests is not None:
@@ -369,6 +375,8 @@ def run_soak(
 
             fresh0 = fresh_compile_count()
             transfers0 = implicit_transfer_count()
+            edges0 = lock_order_edge_count()
+            contention0 = lock_contention_ns()
             for _attempt in range(cfg.crash_loop_budget + 1):
                 delay = poseidon.try_round()
                 if delay is None:
@@ -381,6 +389,7 @@ def run_soak(
                 # (the policy fired; sleeping it for real buys nothing).
             fresh = fresh_compile_count() - fresh0
             transfers = implicit_transfer_count() - transfers0
+            new_edges = lock_order_edges()[edges0:]
             if r >= 1:
                 result["warm_fresh_compiles"] += fresh
                 # The transfer budget-0 window rides NEXT to the compile
@@ -388,6 +397,15 @@ def run_soak(
                 # syncs is the same silent-latency bug class
                 # (TransferLedger; posecheck transfer-discipline).
                 result["warm_implicit_transfers"] += transfers
+                # Third budget-0 gate (LockLedger): round 0 latches the
+                # steady-state lock-acquisition-order graph; a WARM
+                # round growing it means a thread explored a nesting no
+                # earlier round did — a latent ordering (deadlock-
+                # candidate) path, the dynamic twin of posecheck's
+                # lock-order rule.
+                result["warm_lock_order_edges"].extend(
+                    f"{a} -> {b} ({site})" for a, b, site in new_edges
+                )
 
             # Quiesce before the divergence gate: release chaos-held
             # event streams (their damage — a round solved on stale
@@ -418,6 +436,13 @@ def run_soak(
             # planner's own solve window — record both.
             metrics_d["soak_fresh_compiles"] = fresh
             metrics_d["soak_implicit_transfers"] = transfers
+            metrics_d["soak_lock_order_edges"] = len(new_edges)
+            metrics_d["soak_lock_contention_ns"] = (
+                lock_contention_ns() - contention0
+            )
+            result["lock_contention_ns"] += (
+                lock_contention_ns() - contention0
+            )
             result["tiers"].append(metrics.solve_tier)
             result["cost_delta_hits"] += metrics.cost_delta_hits
             digest = _digest(kube_truth)
@@ -483,6 +508,14 @@ def run_soak(
                     "implicit-transfers",
                     f"{result['warm_implicit_transfers']} implicit "
                     "device->host sync(s) in warm rounds (budget 0)",
+                    total_rounds,
+                )
+            if result["warm_lock_order_edges"]:
+                raise SoakFailure(
+                    "lock-order-edges",
+                    f"{len(result['warm_lock_order_edges'])} new lock-"
+                    "acquisition-order edge(s) in warm rounds (budget "
+                    f"0): {result['warm_lock_order_edges'][:5]}",
                     total_rounds,
                 )
         result["ok"] = True

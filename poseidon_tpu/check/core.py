@@ -117,6 +117,11 @@ class Rule:
 
 def all_rules() -> List[Rule]:
     # Local imports: the rule modules import this one for Rule/Finding.
+    from poseidon_tpu.check.concurrency import (
+        BlockingUnderLockRule,
+        LockOrderRule,
+        UnsafePublicationRule,
+    )
     from poseidon_tpu.check.determinism import DeterminismRule
     from poseidon_tpu.check.dispatch_budget import DispatchBudgetRule
     from poseidon_tpu.check.hatch_registry import HatchRegistryRule
@@ -137,6 +142,9 @@ def all_rules() -> List[Rule]:
         TransferDisciplineRule(),
         ShardDisciplineRule(),
         HatchRegistryRule(),
+        LockOrderRule(),
+        BlockingUnderLockRule(),
+        UnsafePublicationRule(),
     ]
 
 

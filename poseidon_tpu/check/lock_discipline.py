@@ -58,6 +58,16 @@ def _lock_factory_names(tree: ast.AST) -> Set[str]:
     for local, orig in from_imports(tree, "threading").items():
         if orig in _LOCK_FACTORIES:
             names.add(local)
+    # The TrackedLock migration (utils/locks.py) must not take classes
+    # OUT of scope: the wrappers are lock factories too.
+    for local, orig in from_imports(
+        tree, "poseidon_tpu.utils.locks"
+    ).items():
+        if orig in ("TrackedLock", "tracked_condition"):
+            names.add(local)
+    for alias in import_aliases(tree, "poseidon_tpu.utils.locks"):
+        names.add(f"{alias}.TrackedLock")
+        names.add(f"{alias}.tracked_condition")
     return names
 
 
@@ -187,10 +197,14 @@ class LockDisciplineRule(Rule):
     # cache is mutated from both the pipeline worker and the planner
     # thread, and the soak harness drives watcher + loop threads over
     # shared round state — both are threaded consumers added since the
-    # rule's PR 1 scope was drawn.
+    # rule's PR 1 scope was drawn.  obs/, service/, replay/ and
+    # graph/residency.py joined with the concurrency rules (PR 16):
+    # every module the TrackedLock migration touches is in scope.
     scopes = (
         "poseidon_tpu/glue/", "poseidon_tpu/graph/pipeline.py",
-        "poseidon_tpu/costmodel/delta.py", "poseidon_tpu/chaos/soak.py",
+        "poseidon_tpu/costmodel/delta.py", "poseidon_tpu/chaos/",
+        "poseidon_tpu/obs/", "poseidon_tpu/service/",
+        "poseidon_tpu/replay/", "poseidon_tpu/graph/residency.py",
     )
 
     def check(self, tree: ast.AST, source: str, path: str) -> List[Finding]:

@@ -15,9 +15,10 @@ from __future__ import annotations
 import copy
 import itertools
 import queue
-import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Tuple
+
+from poseidon_tpu.utils.locks import TrackedLock
 
 
 @dataclass
@@ -108,7 +109,7 @@ class FakeKube(KubeAPI):
     """
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = TrackedLock("glue.FakeKube._lock", reentrant=True)
         self.pods: Dict[str, Pod] = {}
         self.nodes: Dict[str, Node] = {}
         self._pod_watchers: List["queue.Queue[Event]"] = []

@@ -13,14 +13,15 @@ Semantics match the reference's keyed queue (pkg/k8sclient/keyed_queue.go:24-135
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 from typing import Any, Hashable, List, Optional, Tuple
+
+from poseidon_tpu.utils.locks import tracked_condition
 
 
 class KeyedQueue:
     def __init__(self) -> None:
-        self._cond = threading.Condition()
+        self._cond = tracked_condition("glue.KeyedQueue._cond")
         self._queue: "OrderedDict[Hashable, List[Any]]" = OrderedDict()
         self._parked: "OrderedDict[Hashable, List[Any]]" = OrderedDict()
         self._processing: set = set()

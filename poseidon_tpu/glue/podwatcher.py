@@ -29,6 +29,7 @@ from poseidon_tpu.obs import trace as obs_trace
 from poseidon_tpu.protos import firmament_pb2 as fpb
 from poseidon_tpu.service.client import FirmamentClient
 from poseidon_tpu.utils.ids import generate_uuid, task_uid
+from poseidon_tpu.utils.locks import TrackedLock
 
 log = logging.getLogger("poseidon.podwatcher")
 
@@ -65,7 +66,7 @@ class PodWatcher:
         self.workers = workers
         self.queue = KeyedQueue()
         self._jobs: Dict[str, _JobEntry] = {}
-        self._jobs_lock = threading.Lock()
+        self._jobs_lock = TrackedLock("glue.PodWatcher._jobs_lock")
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
         # Observability: how many times the watch dropped and re-synced.

@@ -243,7 +243,13 @@ class Poseidon:
         from the kube truth, and the remaining deltas still enact.
         Unknown ids stay fatal (poseidon.go:43) — they mean the id maps
         themselves are broken, which no retry fixes."""
-        self.last_deltas = []
+        # Round-thread confinement: only the thread driving try_round
+        # (the loop thread, or the soak's main thread with
+        # run_loop=False) writes last_deltas/_enacted; readers consume
+        # AFTER the round returns on that same thread (chaos/soak.py
+        # records last_deltas post-try_round), so these publications
+        # carry their happens-before in program order.
+        self.last_deltas = []  # handoff: round-thread-confined (above)
         with obs_trace.span("glue.flush_resubmits"):
             self._flush_resubmits()
         try:
@@ -263,7 +269,7 @@ class Poseidon:
         # Recorded before enactment so a round that fails mid-enactment
         # still attributes THESE deltas (not a previous round's) to
         # itself in the flight trace.
-        self.last_deltas = list(deltas)
+        self.last_deltas = list(deltas)  # handoff: round-thread-confined
         if getattr(self.fc, "schedule_retried", False):
             # The client absorbed an UNAVAILABLE with a retry.  On a
             # real network that code can surface AFTER the service
@@ -291,7 +297,7 @@ class Poseidon:
         # cluster (the pod watcher owns those transitions) must leave
         # the enacted map, or it grows one entry per pod ever placed.
         live = self.shared.live_uids()
-        self._enacted = {
+        self._enacted = {  # handoff: round-thread-confined (see above)
             uid: node for uid, node in self._enacted.items() if uid in live
         }
         # Cleared only here, after enactment AND reconcile completed: a

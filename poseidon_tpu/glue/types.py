@@ -8,12 +8,12 @@ lock-guarded registry with typed accessors.
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass
 from typing import Dict, Optional
 
 from poseidon_tpu.glue.fake_kube import Node, Pod
 from poseidon_tpu.protos import firmament_pb2 as fpb
+from poseidon_tpu.utils.locks import TrackedLock
 
 
 @dataclass
@@ -36,7 +36,7 @@ class NodeEntry:
 
 class SharedState:
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = TrackedLock("glue.SharedState._lock", reentrant=True)
         self._tasks: Dict[int, TaskEntry] = {}          # task uid -> entry
         self._pod_to_uid: Dict[str, int] = {}           # pod key -> task uid
         self._nodes: Dict[str, NodeEntry] = {}          # node name -> entry

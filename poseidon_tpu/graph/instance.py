@@ -95,6 +95,12 @@ class RoundMetrics:
     # boundary (transport.host_fetch) fetches explicitly and never
     # counts.
     implicit_transfers: int = 0
+    # Nanoseconds threads spent WAITING on tracked locks during this
+    # round's solve window (utils/locks.py process counter diff): the
+    # pipelining contract says the speculative cost build never blocks
+    # the round thread, so a warm round reporting milliseconds here has
+    # a real serialization leak the latency metrics can't see.
+    lock_contention_ns: int = 0
     # Bellman-Ford sweeps spent inside the kernel's global updates — the
     # dominant per-iteration op-count term (tuning signal for
     # global_update_every / bf_max).
@@ -859,10 +865,12 @@ class RoundPlanner:
             implicit_transfer_count,
         )
         from poseidon_tpu.ops.transport import device_call_count
+        from poseidon_tpu.utils.locks import lock_contention_ns
 
         calls0 = device_call_count()
         fresh0 = fresh_compile_count()
         transfers0 = implicit_transfer_count()
+        contention0 = lock_contention_ns()
         # Assignment pipelining: a finished band's EC->task assignment
         # (pure host work, ~0.5 s of a 10k fresh wave) runs on a worker
         # thread WHILE the next band's solve occupies the device — the
@@ -942,6 +950,7 @@ class RoundPlanner:
         metrics.device_calls = device_call_count() - calls0
         metrics.fresh_compiles = fresh_compile_count() - fresh0
         metrics.implicit_transfers = implicit_transfer_count() - transfers0
+        metrics.lock_contention_ns = lock_contention_ns() - contention0
         metrics.solve_seconds = time.perf_counter() - t_solve
         if metrics.gap_bound == float("inf"):
             # Even the cold retry exhausted its iteration budget: the

@@ -31,6 +31,7 @@ from typing import Optional
 
 from poseidon_tpu.obs import trace as _trace
 from poseidon_tpu.utils.hatches import hatch_bool
+from poseidon_tpu.utils.locks import TrackedLock
 
 ENV_GATE = "POSEIDON_PIPELINE_BANDS"
 
@@ -56,7 +57,7 @@ class CostPipeline:
 
     def __init__(self, cache) -> None:
         self._cache = cache
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("graph.CostPipeline._lock")
         self._pool = None
         self._future = None
         self._spec: Optional[_Spec] = None
@@ -82,7 +83,12 @@ class CostPipeline:
         if fut is None:
             return
         try:
-            fut.result()
+            # The join under _lock IS the pipelining contract: every
+            # cache touch serializes behind the outstanding speculative
+            # build (single worker, module docstring) — there is no
+            # second lock to deadlock against, and an unlocked join
+            # would let a fetch read a half-built plane.
+            fut.result()  # posecheck: ignore[blocking-under-lock]
         except Exception:  # noqa: BLE001 - speculative; authoritative re-runs
             pass
         self._future = None

@@ -29,6 +29,7 @@ from typing import Dict, List, Optional
 
 from poseidon_tpu.obs import trace as _trace
 from poseidon_tpu.utils.hatches import hatch_int
+from poseidon_tpu.utils.locks import TrackedLock
 
 # The summary keys /debug/rounds lifts out of each record's metrics
 # dict (missing ones are simply absent — the endpoint must tolerate
@@ -45,7 +46,7 @@ class RoundHistory:
     """Bounded ring of per-round records, keyed by round index."""
 
     def __init__(self, capacity: Optional[int] = None) -> None:
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("obs.RoundHistory._lock")
         self._records: "OrderedDict[int, dict]" = OrderedDict()
         # None = read the hatch at record time (the process-wide
         # default history must honor env changes per the call-time

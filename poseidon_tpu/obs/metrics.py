@@ -32,6 +32,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from poseidon_tpu.obs import trace as _trace
 from poseidon_tpu.obs.history import RoundHistory, default_history
+from poseidon_tpu.utils.locks import TrackedLock
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 JSON_CONTENT_TYPE = "application/json; charset=utf-8"
@@ -84,7 +85,7 @@ class _Child:
     __slots__ = ("lock", "value", "bucket_counts", "sum", "count")
 
     def __init__(self, buckets: Optional[Tuple[float, ...]] = None) -> None:
-        self.lock = threading.Lock()
+        self.lock = TrackedLock("obs.metrics._Child.lock")
         self.value = 0.0
         if buckets is not None:
             self.bucket_counts = [0] * (len(buckets) + 1)  # + +Inf
@@ -107,7 +108,7 @@ class Metric:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("obs.metrics.Metric._lock")
         self._children: Dict[Tuple[str, ...], _Child] = {}
         if not self.labelnames:
             self._children[()] = self._new_child()
@@ -134,12 +135,19 @@ class Metric:
             return list(self._children)
 
     def _samples(self) -> Iterable[Tuple[str, str, float]]:
-        """(suffix, rendered-labels, value) triples, label-sorted."""
+        """(suffix, rendered-labels, value) triples, label-sorted.
+
+        The family lock is held across the WHOLE iteration so one
+        exposition is a consistent snapshot: a scrape racing a
+        ``set_onehot`` transaction (which writes under the same lock)
+        sees the family entirely before or entirely after the flip,
+        never mid-flip.  Plain ``set``/``inc`` writers still only take
+        the child lock — per-child atomicity, no family guarantee."""
         with self._lock:
-            items = sorted(self._children.items())
-        for key, child in items:
-            with child.lock:
-                yield "", _labels_text(self.labelnames, key), child.value
+            for key, child in sorted(self._children.items()):
+                with child.lock:
+                    yield ("", _labels_text(self.labelnames, key),
+                           child.value)
 
     def expose(self) -> str:
         lines = [
@@ -194,6 +202,38 @@ class Gauge(Metric):
         with child.lock:
             return child.value
 
+    def set_onehot(self, *labelvalues, universe=()) -> None:
+        """Atomically mark one labelset 1.0 and every other labelset in
+        the family 0.0, materialising any ``universe`` labelsets that
+        have not been exported yet.
+
+        The whole flip happens under the family lock — the same lock
+        ``_samples`` holds across an exposition — so a concurrent
+        scrape can never observe a torn one-hot (all-zero, or the new
+        labelset published at its default 0.0 before its 1.0 lands).
+        ``universe`` entries are labelvalue tuples, or bare values for
+        single-label families."""
+        target = tuple(str(v) for v in labelvalues)
+        if len(target) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected {len(self.labelnames)} label "
+                f"values, got {len(target)}"
+            )
+        keys = {target}
+        for u in universe:
+            t = u if isinstance(u, tuple) else (u,)
+            keys.add(tuple(str(v) for v in t))
+        with self._lock:
+            for key in sorted(keys):
+                if key not in self._children:
+                    child = self._new_child()
+                    # Pre-valued BEFORE publication: no 0.0 window.
+                    child.value = 1.0 if key == target else 0.0
+                    self._children[key] = child
+            for key, child in self._children.items():
+                with child.lock:
+                    child.value = 1.0 if key == target else 0.0
+
 
 class Histogram(Metric):
     type_name = "histogram"
@@ -247,7 +287,7 @@ class Registry:
     """Named metric families; get-or-create with type/label checking."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("obs.metrics.Registry._lock")
         self._metrics: Dict[str, Metric] = {}
 
     def _get_or_create(self, cls, name: str, help: str,  # noqa: A002
@@ -307,7 +347,7 @@ def default_registry() -> Registry:
 # process has actually been DOING, not just that a socket answers.
 # Timestamps come from obs.trace.monotime() — the telemetry plane's one
 # clock owner (posecheck determinism confinement).
-_HEALTH_LOCK = threading.Lock()
+_HEALTH_LOCK = TrackedLock("obs.metrics._HEALTH_LOCK")
 
 
 def _fresh_health() -> dict:
@@ -502,15 +542,14 @@ def observe_round(metrics, registry: Optional[Registry] = None) -> None:
         "Which degraded-ladder tier served the last round (one-hot)",
         ("tier",),
     )
-    # Zero every labelset ever exported (not just SOLVE_TIERS: a tier
-    # name added to instance.py before this list is updated must not
-    # stay pinned at 1 forever), then mark the serving tier.
-    for key in tier_g.labelsets():
-        tier_g.set(0.0, *key)
-    for t in SOLVE_TIERS:
-        if t != tier:
-            tier_g.set(0.0, t)
-    tier_g.set(1.0, tier)
+    # One transactional flip: the serving tier to 1 and every other
+    # labelset ever exported to 0 (not just SOLVE_TIERS: a tier name
+    # added to instance.py before this list is updated must not stay
+    # pinned at 1 forever), under the family lock an exposition also
+    # holds.  Per-set writes — in any order — left windows a concurrent
+    # scrape could stitch into an all-zero one-hot; the race harness
+    # reproduces the worst (zero-then-set) order in tests/test_races.py.
+    tier_g.set_onehot(tier, universe=SOLVE_TIERS)
     for key in sorted(d):
         val = d[key]
         if val == "inf":
@@ -583,12 +622,42 @@ def observe_loop(stats, *, resyncs: int = 0, crash_loop_budget: int = 0,
     ).set(1.0 if fatal else 0.0)
 
 
+def observe_locks(registry: Optional[Registry] = None) -> None:
+    """Expose the TrackedLock ledger's process-wide counters
+    (utils/locks.py): contention events, time spent waiting, time spent
+    holding, and the size of the observed acquisition-order edge graph.
+    Monotonic sums over every tracked lock ever constructed, so
+    ``set_total`` pins the counters without double counting."""
+    from poseidon_tpu.utils import locks as _locks
+
+    reg = registry or _REGISTRY
+    reg.counter(
+        "poseidon_lock_contention_total",
+        "TrackedLock acquisitions that found the lock held",
+    ).set_total(float(_locks.lock_contention_count()))
+    reg.counter(
+        "poseidon_lock_contention_seconds_total",
+        "Wall seconds tracked-lock acquirers spent waiting",
+    ).set_total(_locks.lock_contention_ns() / 1e9)
+    reg.counter(
+        "poseidon_lock_hold_seconds_total",
+        "Wall seconds tracked locks were held",
+    ).set_total(_locks.lock_hold_ns() / 1e9)
+    reg.gauge(
+        "poseidon_lock_order_edges",
+        "Distinct lock-acquisition-order edges observed (LockLedger)",
+    ).set(float(_locks.lock_order_edge_count()))
+
+
 def observe_ledger(registry: Optional[Registry] = None) -> None:
     """Expose the compile ledger's process-wide counters.  Reads them
     only when jax is already imported: the glue process must not pay a
-    jax import for two series that would read 0 anyway."""
+    jax import for two series that would read 0 anyway.  The lock
+    ledger rides along (every existing call site feeds both): its
+    counters are jax-free, so they export before the gate."""
     import sys
 
+    observe_locks(registry)
     if "jax" not in sys.modules:
         return
     from poseidon_tpu.check.ledger import fresh_compile_count, retrace_count

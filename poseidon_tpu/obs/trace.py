@@ -38,6 +38,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from poseidon_tpu.utils.hatches import hatch_bool, hatch_set
+from poseidon_tpu.utils.locks import TrackedLock
 
 TRACE_ENV = "POSEIDON_TRACE"
 STAGE_ENV = "POSEIDON_STAGE_TIMERS"
@@ -163,7 +164,7 @@ class Tracer:
 
     def __init__(self, max_spans: int = MAX_SPANS,
                  max_counter_samples: int = MAX_COUNTER_SAMPLES) -> None:
-        self._lock = threading.Lock()
+        self._lock = TrackedLock("obs.Tracer._lock")
         self._tl = threading.local()
         self._spans: List[dict] = []
         self._counter_samples: List[dict] = []

@@ -36,6 +36,7 @@ from poseidon_tpu.protos.services import (
 )
 from poseidon_tpu.service import converters
 from poseidon_tpu.utils.config import FirmamentTPUConfig, load_config
+from poseidon_tpu.utils.locks import TrackedLock
 
 log = logging.getLogger("firmament_tpu")
 
@@ -94,11 +95,15 @@ class FirmamentServicer:
         # Schedule() rounds are serialized: the planner's warm-start state
         # is single-writer (the reference client also calls Schedule from
         # one loop, cmd/poseidon/poseidon.go:32-72).
-        self._schedule_lock = threading.Lock()
+        self._schedule_lock = TrackedLock(
+            "service.FirmamentServicer._schedule_lock"
+        )
         # Checkpoint writes happen OUTSIDE the schedule lock (fsync
         # latency must not stall rounds) but must still not interleave
         # with each other (periodic vs shutdown save share a tmp path).
-        self._ckpt_write_lock = threading.Lock()
+        self._ckpt_write_lock = TrackedLock(
+            "service.FirmamentServicer._ckpt_write_lock"
+        )
         self._precompiled = False
 
     # ------------------------------------------------------------- scheduling
@@ -160,7 +165,12 @@ class FirmamentServicer:
             if self.config.profile_dir:
                 import jax
 
-                with jax.profiler.trace(self.config.profile_dir):
+                # Rounds are deliberately serialized on _schedule_lock
+                # (one solver, one device stream); the dispatch runs
+                # under it BY DESIGN, not as an accident of scope.
+                with jax.profiler.trace(  # posecheck: ignore[blocking-under-lock]
+                    self.config.profile_dir
+                ):
                     deltas, metrics = self.planner.schedule_round()
             else:
                 deltas, metrics = self.planner.schedule_round()

@@ -161,6 +161,16 @@ HATCHES: Tuple[Hatch, ...] = (
           "introspection endpoints (obs/history.py)"),
     Hatch("POSEIDON_REPLAY_PROGRESS", "flag", "",
           "Per-round progress breadcrumbs on stderr during replay"),
+    # ----------------------------------------------------------- concurrency
+    Hatch("POSEIDON_LOCK_LEDGER", "bool_on", "1",
+          "TrackedLock order/contention/hold accounting (utils/locks.py); "
+          "0 degrades every tracked lock to a bare delegate"),
+    Hatch("POSEIDON_RACE_SEED", "int", "0",
+          "Base seed for the preemption-point race harness "
+          "(chaos/preempt.py; suite seed k runs at base + k)"),
+    Hatch("POSEIDON_RACE_SWEEP", "int", "3",
+          "Seeded interleavings each race-harness suite drives (CI "
+          "default 3; soak boxes can turn it up)"),
     # ------------------------------------------------------- process plumbing
     Hatch("POSEIDON_COMPILE_CACHE_DIR", "str", "",
           "Persistent XLA compile cache directory for "

@@ -1,0 +1,314 @@
+"""Runtime-half tests for the concurrency discipline PR: TrackedLock
+edge/contention/hold accounting, the LockLedger budget-0 window, the
+tracked Condition, and the seeded preemption harness plumbing
+(chaos/preempt.py).  The static rules' fixture counts live in
+tests/test_check_selfcheck.py; the live interleaving suites in
+tests/test_races.py."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from poseidon_tpu.chaos.preempt import (
+    InvariantTracker,
+    PreemptPoints,
+    race_seeds,
+)
+from poseidon_tpu.utils import locks as L
+
+
+@pytest.fixture(autouse=True)
+def _fresh_edge_graph():
+    # The edge graph is process-global on purpose (the soak diffs it);
+    # these tests mint deliberate edges/cycles, so isolate them.
+    L._reset_edges_for_tests()
+    yield
+    L._reset_edges_for_tests()
+
+
+# ------------------------------------------------------------ TrackedLock
+
+
+def test_tracked_lock_basic_accounting():
+    lk = L.TrackedLock("t.basic")
+    with lk:
+        time.sleep(0.001)
+    assert lk.acquisitions == 1
+    assert lk.hold_ns > 0
+    assert lk.contended == 0
+    # Uncontended single-lock use records no order edges.
+    assert L.lock_order_edge_count() == 0
+
+
+def test_tracked_lock_nonblocking_acquire():
+    lk = L.TrackedLock("t.nonblock")
+    assert lk.acquire(blocking=False)
+    # Held: a second non-blocking attempt from another thread fails
+    # without recording contention time.
+    got = []
+    t = threading.Thread(
+        target=lambda: got.append(lk.acquire(blocking=False))
+    )
+    t.start()
+    t.join()
+    assert got == [False]
+    lk.release()
+
+
+def test_tracked_lock_reentrant():
+    lk = L.TrackedLock("t.rlock", reentrant=True)
+    with lk:
+        with lk:  # nested owner re-acquire: no self-edge, no deadlock
+            assert lk.acquisitions == 1
+    assert L.lock_order_edge_count() == 0
+    # Re-acquirable after full release.
+    with lk:
+        pass
+    assert lk.acquisitions == 2
+
+
+def test_order_edge_recorded_once():
+    a = L.TrackedLock("t.edge.a")
+    b = L.TrackedLock("t.edge.b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert L.lock_order_edge_count() == 1
+    (src, dst, site) = L.lock_order_edges()[0]
+    assert (src, dst) == ("t.edge.a", "t.edge.b")
+    assert site  # first-observation call site attributed
+
+
+def test_cycle_detected_on_opposite_order():
+    a = L.TrackedLock("t.cyc.a")
+    b = L.TrackedLock("t.cyc.b")
+    with a:
+        with b:
+            pass
+    assert L.lock_cycles() == []
+    with b:
+        with a:
+            pass
+    cycles = L.lock_cycles()
+    assert len(cycles) == 1
+    assert "t.cyc.a" in cycles[0] and "t.cyc.b" in cycles[0]
+
+
+def test_contention_accounted():
+    lk = L.TrackedLock("t.contend")
+    release = threading.Event()
+    held = threading.Event()
+
+    def holder():
+        with lk:
+            held.set()
+            release.wait(2.0)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert held.wait(2.0)
+    t0 = L.lock_contention_ns()
+    threading.Timer(0.02, release.set).start()
+    with lk:
+        pass
+    t.join()
+    assert lk.contended == 1
+    assert L.lock_contention_ns() - t0 > 0
+    stats = L.per_lock_stats()["t.contend"]
+    assert stats["contended"] == 1.0
+    assert stats["acquisitions"] == 2.0
+
+
+def test_hatch_disables_tracking(monkeypatch):
+    monkeypatch.setenv("POSEIDON_LOCK_LEDGER", "0")
+    a = L.TrackedLock("t.off.a")
+    b = L.TrackedLock("t.off.b")
+    with a:
+        with b:
+            pass
+    assert L.lock_order_edge_count() == 0
+    assert a.acquisitions == 0  # degraded to a bare delegate
+
+
+def test_tracked_condition_wait_notify():
+    cond = L.tracked_condition("t.cond")
+    ready = []
+
+    def waiter():
+        with cond:
+            while not ready:
+                cond.wait(timeout=2.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.01)
+    with cond:
+        ready.append(1)
+        cond.notify()
+    t.join(2.0)
+    assert not t.is_alive()
+    # Waiting on the condition's own lock is not an order edge.
+    assert L.lock_order_edge_count() == 0
+
+
+# ------------------------------------------------------------- LockLedger
+
+
+def test_ledger_passes_on_known_edges():
+    a = L.TrackedLock("t.led.a")
+    b = L.TrackedLock("t.led.b")
+    with a:
+        with b:
+            pass
+    # Edge latched before the window: re-walking it is budget-clean.
+    with L.LockLedger(label="warm"):
+        with a:
+            with b:
+                pass
+
+
+def test_ledger_raises_on_new_edge():
+    a = L.TrackedLock("t.led2.a")
+    b = L.TrackedLock("t.led2.b")
+    with pytest.raises(L.LockBudgetExceeded, match="lock-order edge"):
+        with L.LockLedger(label="warm"):
+            with a:
+                with b:
+                    pass
+
+
+def test_ledger_telemetry_mode_records_without_raising():
+    a = L.TrackedLock("t.led3.a")
+    b = L.TrackedLock("t.led3.b")
+    with L.LockLedger(budget=None, label="telemetry") as led:
+        with a:
+            with b:
+                pass
+    assert [(s, d) for s, d, _ in led.new_edges] == [
+        ("t.led3.a", "t.led3.b")
+    ]
+
+
+def test_ledger_flags_sleep_under_lock():
+    lk = L.TrackedLock("t.led4")
+    with pytest.raises(L.LockBudgetExceeded, match="blocking call"):
+        with L.LockLedger(label="warm"):
+            with lk:
+                time.sleep(0)
+
+
+def test_ledger_flags_queue_get_under_lock():
+    import queue
+
+    lk = L.TrackedLock("t.led5")
+    q = queue.Queue()
+    q.put(1)
+    with pytest.raises(L.LockBudgetExceeded, match="blocking call"):
+        with L.LockLedger(label="warm"):
+            with lk:
+                q.get()
+
+
+def test_ledger_allows_blocking_outside_lock():
+    import queue
+
+    q = queue.Queue()
+    q.put(1)
+    with L.LockLedger(label="warm"):
+        time.sleep(0)
+        q.get()
+
+
+def test_ledger_covers_threads_started_in_window():
+    a = L.TrackedLock("t.led6.a")
+    b = L.TrackedLock("t.led6.b")
+
+    def nest():
+        with a:
+            with b:
+                pass
+
+    with pytest.raises(L.LockBudgetExceeded):
+        with L.LockLedger(label="warm"):
+            t = threading.Thread(target=nest)
+            t.start()
+            t.join()
+
+
+def test_ledger_body_exception_wins():
+    a = L.TrackedLock("t.led7.a")
+    b = L.TrackedLock("t.led7.b")
+    with pytest.raises(ValueError):
+        with L.LockLedger(label="warm"):
+            with a:
+                with b:
+                    raise ValueError("body failure")
+
+
+# ---------------------------------------------------------- preempt hooks
+
+
+def test_preempt_points_fire_and_are_seeded():
+    lk = L.TrackedLock("t.pp")
+    with PreemptPoints(seed=7) as pp:
+        for _ in range(10):
+            with lk:
+                pass
+    first = pp.decisions
+    assert first >= 10  # at least one decision per acquire
+    with PreemptPoints(seed=7) as pp2:
+        for _ in range(10):
+            with lk:
+                pass
+    assert pp2.decisions == first
+
+
+def test_preempt_points_reject_nesting():
+    with PreemptPoints(seed=0):
+        with pytest.raises(RuntimeError, match="already installed"):
+            with PreemptPoints(seed=1):
+                pass
+    # Uninstalled on exit: a fresh install works.
+    with PreemptPoints(seed=2):
+        pass
+
+
+def test_race_seeds_hatches(monkeypatch):
+    monkeypatch.setenv("POSEIDON_RACE_SEED", "100")
+    monkeypatch.setenv("POSEIDON_RACE_SWEEP", "4")
+    assert list(race_seeds()) == [100, 101, 102, 103]
+    assert list(race_seeds(sweep=2)) == [100, 101]
+    monkeypatch.setenv("POSEIDON_RACE_SWEEP", "0")
+    assert list(race_seeds()) == [100]  # never empty
+
+
+def test_invariant_tracker_records_overlap():
+    tr = InvariantTracker()
+    tr.enter("k", "t1")
+    tr.enter("k", "t2")
+    tr.exit("k", "t2")
+    tr.exit("k", "t1")
+    assert len(tr.violations) == 1
+    assert "t1" in tr.violations[0] and "t2" in tr.violations[0]
+
+
+# ------------------------------------------------------- metrics export
+
+
+def test_observe_locks_exports_series():
+    from poseidon_tpu.obs import metrics as obs_metrics
+
+    lk = L.TrackedLock("t.metrics")
+    with lk:
+        pass
+    reg = obs_metrics.Registry()
+    obs_metrics.observe_locks(reg)
+    text = reg.expose()
+    assert "poseidon_lock_contention_total" in text
+    assert "poseidon_lock_contention_seconds_total" in text
+    assert "poseidon_lock_hold_seconds_total" in text
+    assert "poseidon_lock_order_edges" in text

@@ -45,6 +45,8 @@ def _findings(rule: str, fixture: str):
         ("determinism", "determinism_clean.py"),
         ("determinism", "chaos_plan_clean.py"),
         ("retrace-guard", "retrace_guard_clean.py"),
+        ("blocking-under-lock", "concurrency_clean.py"),
+        ("unsafe-publication", "concurrency_clean.py"),
     ],
 )
 def test_clean_fixture_has_no_findings(rule, fixture):
@@ -366,6 +368,75 @@ def test_dispatch_budget_never_judges_file_list_scans():
     assert [f for f in found if f.rule == "dispatch-budget"] == []
     # The directory walk DOES judge (and the live tree is wired clean).
     assert run([str(REPO / "poseidon_tpu")], root=REPO) == []
+
+
+# ----------------------------------------------------- concurrency rules
+
+
+def test_concurrency_clean_fixture():
+    from poseidon_tpu.check.concurrency import LockOrderRule
+
+    assert _findings(
+        "blocking-under-lock", "concurrency_clean.py"
+    ) == []
+    assert _findings(
+        "unsafe-publication", "concurrency_clean.py"
+    ) == []
+    assert _project_findings(
+        LockOrderRule(), "concurrency_clean.py"
+    ) == []
+
+
+def test_lock_order_violations():
+    from poseidon_tpu.check.concurrency import LockOrderRule
+
+    found = _project_findings(
+        LockOrderRule(), "concurrency_violations.py"
+    )
+    assert len(found) == 2
+    msgs = [f.message for f in found]
+    # The in-class AB/BA cycle and the cross-class call cycle, each
+    # reported once (both traversal directions dedupe to one finding).
+    assert sum("TwoLocks._a -> TwoLocks._b" in m for m in msgs) == 1
+    assert sum("Outer._mu -> Inner._gate" in m for m in msgs) == 1
+    assert all("potential deadlock" in m for m in msgs)
+
+
+def test_blocking_under_lock_violations():
+    found = _findings(
+        "blocking-under-lock", "concurrency_violations.py"
+    )
+    msgs = [f.message for f in found]
+    assert len(found) == 5
+    assert sum("sleep" in m for m in msgs) == 1
+    assert sum(".join()" in m for m in msgs) == 1
+    assert sum(".get()" in m for m in msgs) == 1
+    assert sum(".result()" in m for m in msgs) == 1
+    # Event.wait under the lock counts; Condition.wait on the HELD
+    # lock (legal_condition_wait) and the suppressed sleep do not.
+    assert sum(".wait()" in m for m in msgs) == 1
+
+
+def test_unsafe_publication_violations():
+    found = _findings(
+        "unsafe-publication", "concurrency_violations.py"
+    )
+    assert len(found) == 2
+    attrs = {f.message.split("self.")[1].split(" ")[0] for f in found}
+    # The locked rebuild, the `# handoff:` swap, and the threadless
+    # QuietPublisher are all exempt.
+    assert attrs == {"_state", "_snapshots"}
+
+
+def test_concurrency_scope():
+    from poseidon_tpu.check.concurrency import BlockingUnderLockRule
+
+    rule = BlockingUnderLockRule()
+    assert rule.applies_to("poseidon_tpu/glue/poseidon.py")
+    assert rule.applies_to("poseidon_tpu/obs/metrics.py")
+    assert rule.applies_to("poseidon_tpu/service/server.py")
+    assert rule.applies_to("poseidon_tpu/graph/pipeline.py")
+    assert not rule.applies_to("poseidon_tpu/ops/transport.py")
 
 
 # ---------------------------------------------------------------- mechanics

@@ -1,0 +1,359 @@
+"""Seeded interleaving suites (the preemption-point race harness,
+chaos/preempt.py) over the three thread boundaries the concurrency PR
+hardened:
+
+- CostPipeline speculate/build racing from two threads: cache builds
+  must stay strictly serialized (the pipelining contract);
+- MetricsServer scrapes racing ``observe_round``: the solve-tier
+  one-hot must never read all-zero — including the REGRESSION test that
+  re-creates the pre-fix zero-then-set write order and shows the
+  harness catches the tear the fixed order can't produce;
+- watcher-resync-style SharedState churn racing enactment-style
+  readers: the id maps must stay mutually consistent.
+
+Every TrackedLock acquire/release is a preemption point; the same seed
+replays the same schedule pressure (chaos/preempt.race_seeds sweeps
+POSEIDON_RACE_SWEEP of them from POSEIDON_RACE_SEED).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from poseidon_tpu.chaos.preempt import (
+    InvariantTracker,
+    PreemptPoints,
+    race_seeds,
+)
+from poseidon_tpu.obs import metrics as obs_metrics
+from poseidon_tpu.utils import locks as L
+
+SEEDS = list(race_seeds())
+
+
+@pytest.fixture(autouse=True)
+def _fresh_edge_graph():
+    L._reset_edges_for_tests()
+    yield
+    L._reset_edges_for_tests()
+
+
+# ------------------------------------------- CostPipeline speculate/build
+
+
+class _SerialCache:
+    """Cache stub: records build sections; any overlap is a violation."""
+
+    def __init__(self, tracker: InvariantTracker) -> None:
+        self.tracker = tracker
+        self.builds = 0
+        self.last_stats = {"stub": True}
+
+    def build(self, key, ecs_b, mt_b):
+        me = threading.current_thread().name
+        self.tracker.enter("cache", me)
+        self.builds += 1
+        self.tracker.exit("cache", me)
+        return {"key": key}
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_pipeline_speculate_build_stays_serialized(seed):
+    from poseidon_tpu.graph.pipeline import CostPipeline
+
+    tracker = InvariantTracker()
+    cache = _SerialCache(tracker)
+    pipe = CostPipeline(cache)
+    errors = []
+
+    def speculator():
+        try:
+            for k in range(20):
+                pipe.speculate(k, None, None)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def builder():
+        try:
+            for k in range(20):
+                cm, _stats = pipe.build(k, None, None)
+                assert cm == {"key": k}
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    with PreemptPoints(seed=seed):
+        threads = [
+            threading.Thread(target=speculator, name="spec"),
+            threading.Thread(target=builder, name="auth"),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive()
+        pipe.drain()
+    assert errors == []
+    assert tracker.violations == [], tracker.violations
+    # Every authoritative build ran; speculative ones may be superseded.
+    assert cache.builds >= 20
+
+
+# -------------------------------- MetricsServer scrape vs observe_round
+
+
+def _tier_values(text: str):
+    """tier -> value from a /metrics exposition."""
+    return {
+        m.group(1): float(m.group(2))
+        for m in re.finditer(
+            r'poseidon_round_solve_tier\{tier="([^"]+)"\}\s+([0-9.e+-]+)',
+            text,
+        )
+    }
+
+
+def _old_zero_then_set(tier_g, tier):
+    """The PRE-FIX observe_round write order: zero every labelset, THEN
+    mark the serving tier — leaving an all-zero window a concurrent
+    scrape can land in."""
+    for key in tier_g.labelsets():
+        tier_g.set(0.0, *key)
+    for t in obs_metrics.SOLVE_TIERS:
+        if t != tier:
+            tier_g.set(0.0, t)
+    tier_g.set(1.0, tier)
+
+
+def _tier_storm(write_one, reg, rounds):
+    """Drive tier writes against a scraping reader; returns the number
+    of all-zero scrapes observed."""
+    tears = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            vals = _tier_values(reg.expose())
+            if vals and all(v == 0.0 for v in vals.values()):
+                tears.append(dict(vals))
+
+    t = threading.Thread(target=reader, name="scraper")
+    t.start()
+    tiers = obs_metrics.SOLVE_TIERS
+    for i in range(rounds):
+        write_one(tiers[i % len(tiers)])
+        if tears:
+            break
+    stop.set()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    return len(tears)
+
+
+def test_tier_onehot_tear_reproduced_with_prefix_order():
+    """REGRESSION: the pre-fix zero-then-set order tears under the
+    harness — the reader catches an all-zero one-hot.  This is the
+    interleaving failure the PR fixed in observe_round (set the serving
+    tier first); the companion test below holds the fixed order to
+    zero tears under the same storm."""
+    found = 0
+    for seed in race_seeds(sweep=6):
+        reg = obs_metrics.Registry()
+        tier_g = reg.gauge(
+            "poseidon_round_solve_tier", "one-hot", ("tier",)
+        )
+        tier_g.set(1.0, "none")
+        with PreemptPoints(seed=seed, p_park=0.3, p_yield=0.4):
+            found += _tier_storm(
+                lambda t: _old_zero_then_set(tier_g, t), reg, 400
+            )
+        if found:
+            break
+    assert found > 0, (
+        "pre-fix write order never tore; the harness lost its "
+        "regression sensitivity"
+    )
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_observe_round_keeps_onehot_under_scrape(seed):
+    reg = obs_metrics.Registry()
+    tiers = obs_metrics.SOLVE_TIERS
+
+    def write_one(tier):
+        obs_metrics.observe_round(
+            {"round_index": 1, "solve_tier": tier}, reg
+        )
+
+    with PreemptPoints(seed=seed, p_park=0.3, p_yield=0.4):
+        tears = _tier_storm(write_one, reg, 120)
+    assert tears == 0
+    # Steady state: exactly one tier serving.
+    vals = _tier_values(reg.expose())
+    assert sum(1 for v in vals.values() if v == 1.0) == 1
+    assert set(vals) >= set(tiers)
+
+
+@pytest.mark.parametrize("seed", SEEDS[:2])
+def test_http_scrape_during_observe_round(seed):
+    """End-to-end: a real MetricsServer thread answering GETs while
+    observe_round feeds — every HTTP scrape sees a serving tier."""
+    reg = obs_metrics.Registry()
+    server = obs_metrics.MetricsServer("127.0.0.1:0", registry=reg).start()
+    try:
+        obs_metrics.observe_round(
+            {"round_index": 0, "solve_tier": "none"}, reg
+        )
+        stop = threading.Event()
+        bad = []
+
+        def scraper():
+            url = f"http://{server.address}/metrics"
+            while not stop.is_set():
+                with urllib.request.urlopen(url, timeout=5) as resp:
+                    vals = _tier_values(resp.read().decode())
+                if vals and not any(v == 1.0 for v in vals.values()):
+                    bad.append(vals)
+
+        t = threading.Thread(target=scraper)
+        t.start()
+        tiers = obs_metrics.SOLVE_TIERS
+        with PreemptPoints(seed=seed):
+            for i in range(60):
+                obs_metrics.observe_round(
+                    {"round_index": i, "solve_tier": tiers[i % len(tiers)]},
+                    reg,
+                )
+        stop.set()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert bad == [], f"scrapes saw no serving tier: {bad[:3]}"
+    finally:
+        server.stop()
+
+
+# ------------------------------ watcher resync racing enactment readers
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_shared_state_resync_vs_enactment(seed):
+    """A resync-style writer re-registers/removes tasks (what the pod
+    watcher does after a dropped watch) while enactment-style readers
+    walk the id maps (what ``_reconcile_after_failure`` and the stats
+    path do).  The maps must stay mutually consistent: a uid the
+    reader got from ``uid_for_pod`` must resolve back to the same pod,
+    and ``live_uids`` must never contain a finished/removed task."""
+    from poseidon_tpu.glue.fake_kube import Pod
+    from poseidon_tpu.glue.types import SharedState
+    from poseidon_tpu.protos import firmament_pb2 as fpb
+
+    shared = SharedState()
+    n = 24
+    pods = [Pod(name=f"p{i}") for i in range(n)]
+    errors = []
+    stop = threading.Event()
+
+    def resyncer():
+        # Churn: re-register (MODIFIED after resync), finish, remove,
+        # re-add — the full lifecycle the watcher drives.
+        try:
+            for cycle in range(15):
+                for i, pod in enumerate(pods):
+                    uid = 1000 + i
+                    shared.put_task(uid, pod, fpb.TaskDescriptor(uid=uid))
+                for i in range(0, n, 3):
+                    shared.mark_finished(1000 + i)
+                for i in range(0, n, 6):
+                    shared.pop_task(1000 + i)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+        finally:
+            stop.set()
+
+    def enactor():
+        try:
+            while not stop.is_set():
+                for uid, pod in shared.live_uids().items():
+                    entry = shared.get_task(uid)
+                    if entry is not None and entry.pod.key != pod.key:
+                        errors.append(
+                            AssertionError(f"uid {uid} pod mismatch")
+                        )
+                for pod in pods:
+                    uid = shared.uid_for_pod(pod.key)
+                    if uid is None:
+                        continue
+                    back = shared.task_for_uid(uid)
+                    if back is not None and back.key != pod.key:
+                        errors.append(
+                            AssertionError(f"{pod.key} -> {uid} -> "
+                                           f"{back.key}")
+                        )
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    with PreemptPoints(seed=seed):
+        threads = [
+            threading.Thread(target=resyncer, name="resync"),
+            threading.Thread(target=enactor, name="enact-a"),
+            threading.Thread(target=enactor, name="enact-b"),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive()
+    assert errors == [], errors[:3]
+
+
+@pytest.mark.parametrize("seed", SEEDS[:2])
+def test_keyed_queue_under_preemption(seed):
+    """PR 1's KeyedQueue storm, re-driven through the seeded harness:
+    the tracked Condition turns every queue operation into a preemption
+    point, widening the park/hand-off windows the original test relied
+    on thread-count brute force to hit."""
+    from poseidon_tpu.glue.keyed_queue import KeyedQueue
+
+    q = KeyedQueue()
+    tracker = InvariantTracker()
+    done = []
+
+    def producer():
+        for i in range(40):
+            for k in range(4):
+                q.add(f"k{k}", i)
+
+    def worker(name):
+        while True:
+            batch = q.get()
+            if batch is None:
+                return
+            key, items = batch
+            tracker.enter(key, name)
+            tracker.exit(key, name)
+            done.extend(items)
+            q.done(key)
+
+    with PreemptPoints(seed=seed):
+        threads = [threading.Thread(target=producer)] + [
+            threading.Thread(target=worker, args=(f"w{i}",))
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        threads[0].join(timeout=60)
+        assert not threads[0].is_alive()
+        deadline = threading.Event()
+        for _ in range(30_000):
+            if len(q) == 0:
+                break
+            deadline.wait(0.001)
+        q.shut_down()
+        for t in threads[1:]:
+            t.join(timeout=60)
+            assert not t.is_alive()
+    assert tracker.violations == []
+    assert len(done) == 160
