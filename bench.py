@@ -425,6 +425,12 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
     wave_entry_phase = []
     wave_telem_samples = []
     wave_telem_iters_to_90 = []
+    wave_sharded_bands = []
+    wave_shard_imbalance = []
+    # Solver-tier fingerprint of the rung (sorted unique): bench_compare
+    # refuses to diff device-work series across DIFFERENT tier mixes —
+    # a sharded rung's per-device counts are not a single-chip rung's.
+    solve_tiers = set()
     placed = unsched = 0
     objective = 0
     for r in range(rounds):
@@ -441,6 +447,9 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
         wave_entry_phase.append(metrics.ladder_entry_phase)
         wave_telem_samples.append(metrics.telem_samples)
         wave_telem_iters_to_90.append(metrics.telem_iters_to_90)
+        wave_sharded_bands.append(metrics.sharded_bands)
+        wave_shard_imbalance.append(metrics.shard_imbalance)
+        solve_tiers.add(metrics.solve_tier)
         placed, unsched = metrics.placed, metrics.unscheduled
         objective = metrics.objective
         converged = converged and metrics.converged
@@ -486,6 +495,7 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
         churn_device_calls.append(metrics.device_calls)
         churn_rows_rebuilt += metrics.cost_rows_rebuilt
         churn_cols_rebuilt += metrics.cost_cols_rebuilt
+        solve_tiers.add(metrics.solve_tier)
         converged = converged and metrics.converged
         if verbose:
             print(f"# [{machines}] churn {r}: {dt:.3f}s "
@@ -537,6 +547,9 @@ def run_rung(machines: int, tasks: int, ecs: int, rounds: int,
         # lives in the round history + Perfetto counter tracks).
         "wave_telem_samples": wave_telem_samples,
         "wave_telem_iters_to_90": wave_telem_iters_to_90,
+        "wave_sharded_bands": wave_sharded_bands,
+        "wave_shard_imbalance": wave_shard_imbalance,
+        "solve_tiers": sorted(solve_tiers),
         "churn_solve_iters": churn_solve_iters,
         "churn_device_calls": churn_device_calls,
         "churn_delta_hits": churn_delta_hits,
@@ -921,7 +934,184 @@ def run_parity() -> dict:
     }
 
 
-def build_artifact(rungs, target, parity, trace, features) -> dict:
+CLUSTER_RUNG = (100_000, 1_000_000)
+
+
+def run_cluster_rung(machines: int, tasks: int, ecs: int, rounds: int,
+                     verbose: bool) -> dict:
+    """The cluster-scale rung (default 100k machines / 1M tasks,
+    ``CLUSTER_RUNG``): the sharded band tier serves the wave on the
+    visible device mesh, with per-device work series in the artifact
+    and a sharded-vs-dense objective-parity gate sampled at REDUCED
+    scale — a full dense oracle solve at 100k is infeasible inside a
+    bench budget, and the mesh kernel is bit-identical to the
+    single-chip kernel at gate widths, so the reduced sample is the
+    honest check (the randomized planner-level parity suite pins the
+    same claim in tests).
+
+    Partial-progress lines follow run_rung's protocol: each completed
+    stage prints a superset JSON line, so a parent-side timeout
+    mid-rung still salvages the parity verdict and any wave measured
+    so far."""
+    import jax
+
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+
+    backend = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    partial = {
+        "machines": machines, "tasks": tasks, "backend": backend,
+        "devices": n_dev, "ok": False,
+    }
+    if n_dev < 2:
+        return {**partial,
+                "error": "cluster rung needs a multi-device mesh "
+                         "(real, or JAX_PLATFORMS=cpu + XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=N)"}
+    # The sharded tier is opt-in (hatch default OFF); this rung IS the
+    # opt-in.  A subprocess child, so the mutation is contained.
+    os.environ["POSEIDON_SHARDED_BANDS"] = "1"
+
+    def _parity_round(sharded: bool):
+        # Same reduced instance both legs (build_cluster is seeded).
+        # The gate thresholds are production-tuned for cluster widths;
+        # the parity sample lowers them so the tier actually serves
+        # the reduced wave instead of (rightly) declining it.
+        os.environ["POSEIDON_SHARDED_BANDS"] = "1" if sharded else "0"
+        os.environ["POSEIDON_SHARDED_MIN_COLS"] = "1024"
+        os.environ["POSEIDON_SHARDED_MIN_CONTENTION"] = "1"
+        try:
+            st = build_cluster(p_machines, p_tasks, ecs, seed=3)
+            pl = RoundPlanner(st, get_cost_model("cpu_mem"))
+            _, m = pl.schedule_round()
+        finally:
+            os.environ["POSEIDON_SHARDED_BANDS"] = "1"
+            os.environ.pop("POSEIDON_SHARDED_MIN_COLS", None)
+            os.environ.pop("POSEIDON_SHARDED_MIN_CONTENTION", None)
+        return m
+
+    p_machines, p_tasks = min(machines, 4_000), min(tasks, 40_000)
+    m_sh = _parity_round(sharded=True)
+    m_dn = _parity_round(sharded=False)
+    parity_ok = bool(
+        m_sh.solve_tier == "sharded"
+        and m_sh.objective == m_dn.objective
+        and m_sh.placed == m_dn.placed
+        and m_sh.gap_bound == 0.0 and m_dn.gap_bound == 0.0
+    )
+    partial.update(
+        parity_machines=p_machines, parity_tasks=p_tasks,
+        parity_sharded_tier=m_sh.solve_tier,
+        parity_dense_tier=m_dn.solve_tier,
+        parity_objective=int(m_sh.objective),
+        parity_dense_objective=int(m_dn.objective),
+        sharded_parity_ok=parity_ok,
+        partial="after reduced-scale parity",
+    )
+    print(json.dumps(partial), flush=True)
+    if verbose:
+        print(f"# [cluster] parity {p_machines}/{p_tasks}: "
+              f"sharded={m_sh.objective} ({m_sh.solve_tier}) "
+              f"dense={m_dn.objective} ok={parity_ok}", file=sys.stderr)
+
+    # ---- the cluster-scale rung itself.
+    state = build_cluster(machines, tasks, ecs, seed=0)
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+    t0 = time.perf_counter()
+    _, metrics = planner.schedule_round()
+    cold_s = time.perf_counter() - t0
+    converged = metrics.converged
+    partial.update(
+        cold_s=round(cold_s, 4), cold_tier=metrics.solve_tier,
+        partial="after cold round",
+    )
+    print(json.dumps(partial), flush=True)
+    if verbose:
+        print(f"# [cluster] cold: {cold_s:.3f}s tier={metrics.solve_tier} "
+              f"placed={metrics.placed} unsched={metrics.unscheduled} "
+              f"shards={metrics.shard_devices}", file=sys.stderr)
+
+    def _shard_lanes():
+        # Per-shard excess totals of the round's dominant sharded curve
+        # (the artifact's per-device work split; the full downsampled
+        # lanes ride the round history / flight recorder).
+        curves = [c for c in planner.last_solve_curves
+                  if c.get("shard_excess")]
+        if not curves:
+            return []
+        dom = max(curves, key=lambda c: c.get("samples", 0))
+        return [int(sum(lane)) for lane in dom["shard_excess"]]
+
+    wave_lat, churn_lat = [], []
+    wave_device_calls, wave_solve_iters = [], []
+    wave_sharded_bands, wave_shard_imbalance = [], []
+    solve_tiers = {metrics.solve_tier}
+    shard_lanes = _shard_lanes()
+    rng = np.random.default_rng(12345)
+    placed = unsched = objective = 0
+    for r in range(rounds):
+        # Cluster-scale steady state is churn, not drain/resubmit: a
+        # fresh 1M-task wave per round would make the rung all host
+        # submission overhead (and the cold round above already IS the
+        # full wave).
+        churn_step(state, rng, frac=1000)
+        t0 = time.perf_counter()
+        _, metrics = planner.schedule_round()
+        dt = time.perf_counter() - t0
+        churn_lat.append(dt)
+        wave_device_calls.append(metrics.device_calls)
+        wave_solve_iters.append(metrics.iterations)
+        wave_sharded_bands.append(metrics.sharded_bands)
+        wave_shard_imbalance.append(metrics.shard_imbalance)
+        solve_tiers.add(metrics.solve_tier)
+        shard_lanes = _shard_lanes() or shard_lanes
+        placed, unsched = metrics.placed, metrics.unscheduled
+        objective = metrics.objective
+        converged = converged and metrics.converged
+        if verbose:
+            print(f"# [cluster] churn {r}: {dt:.3f}s "
+                  f"tier={metrics.solve_tier} iters={metrics.iterations} "
+                  f"calls={metrics.device_calls} "
+                  f"imbalance={metrics.shard_imbalance}", file=sys.stderr)
+        partial.update(
+            churn_p50_s=round(float(np.percentile(churn_lat, 50)), 4),
+            partial=f"after churn {r + 1}/{rounds}",
+        )
+        print(json.dumps(partial), flush=True)
+
+    return {
+        "machines": machines,
+        "tasks": tasks,
+        "backend": backend,
+        "devices": n_dev,
+        "cold_s": round(cold_s, 4),
+        "churn_p50_s": (
+            round(float(np.percentile(churn_lat, 50)), 4)
+            if churn_lat else None
+        ),
+        "parity_machines": p_machines,
+        "parity_tasks": p_tasks,
+        "parity_objective": int(m_sh.objective),
+        "parity_dense_objective": int(m_dn.objective),
+        "sharded_parity_ok": parity_ok,
+        # Per-device work series (machine-independent counts).
+        "device_calls": wave_device_calls,
+        "solve_iters": wave_solve_iters,
+        "sharded_bands": wave_sharded_bands,
+        "shard_imbalance": wave_shard_imbalance,
+        "shard_excess_totals": shard_lanes,
+        "solve_tiers": sorted(solve_tiers),
+        "placed": placed,
+        "unscheduled": unsched,
+        "objective": objective,
+        "converged": converged,
+        "ok": bool(parity_ok and converged),
+    }
+
+
+def build_artifact(rungs, target, parity, trace, features,
+                   cluster=None) -> dict:
     """The scored JSON line the driver records.
 
     Scores ONLY the target config (the north star, or the requested
@@ -954,6 +1144,12 @@ def build_artifact(rungs, target, parity, trace, features) -> dict:
         "features": features,
         "ladder": rungs,
     }
+    if cluster is not None:
+        # The opt-in cluster-scale rung (CLUSTER_RUNG): sharded-tier
+        # wave + churn with its own reduced-scale parity verdict and
+        # per-device work series.  Not the scored number — the north
+        # star stays the target config above.
+        out["cluster"] = cluster
     if best is None:
         out.update({"value": None, "vs_baseline": 0.0,
                     "error": f"target rung {target[0]}/{target[1]} "
@@ -990,6 +1186,8 @@ def build_artifact(rungs, target, parity, trace, features) -> dict:
         for key in ("wave_solve_iters", "wave_bf_sweeps",
                     "wave_device_calls", "wave_entry_phase",
                     "wave_telem_samples", "wave_telem_iters_to_90",
+                    "wave_sharded_bands", "wave_shard_imbalance",
+                    "solve_tiers",
                     "churn_solve_iters", "churn_device_calls",
                     "churn_delta_hits"):
             if key in best:
@@ -1101,13 +1299,28 @@ def main(argv=None) -> int:
     p.add_argument("--rounds", type=int, default=5)
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--child",
-                   choices=["rung", "parity", "trace", "features", "soak"],
+                   choices=["rung", "parity", "trace", "features", "soak",
+                            "cluster"],
                    default=None)
+    p.add_argument("--cluster", action="store_true",
+                   help="also run the opt-in cluster-scale rung "
+                        "(CLUSTER_RUNG; sharded band tier)")
     p.add_argument("--plan", default="smoke",
                    help="fault plan name for --child soak")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)
 
+    if args.child == "cluster":
+        # The sharded tier needs a device mesh: on host-only backends
+        # force a virtual one BEFORE jax initializes (a no-op when the
+        # flag is already present or a real multi-device backend is
+        # attached).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if ("xla_force_host_platform_device_count" not in flags
+                and os.environ.get("JAX_PLATFORMS", "") == "cpu"):
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     if args.child is not None:
         _ensure_live_backend()
         # Persistent compile cache: rung/trace children each start a fresh
@@ -1137,6 +1350,13 @@ def main(argv=None) -> int:
             args.machines or 200, max(args.rounds, 8), args.plan, args.seed
         )))
         return 0
+    if args.child == "cluster":
+        print(json.dumps(run_cluster_rung(
+            args.machines or CLUSTER_RUNG[0],
+            args.tasks or CLUSTER_RUNG[1],
+            args.ecs, args.rounds, args.verbose,
+        )))
+        return 0
 
     # ---- parent: drive the stages; never touches jax (the probe runs in
     # a disposable subprocess), and re-emits the running JSON line after
@@ -1154,11 +1374,13 @@ def main(argv=None) -> int:
     parity = {"ok": False, "error": "not run"}
     trace = {"ok": False, "error": "not run"}
     features = {"ok": False, "error": "not run"}
+    cluster = None
 
     live_evidence = _load_last_live_tpu(target)  # once; None when absent
 
     def emit():
-        art = build_artifact(rungs, target, parity, trace, features)
+        art = build_artifact(rungs, target, parity, trace, features,
+                             cluster=cluster)
         if art.get("backend") != "tpu" and live_evidence is not None:
             art["last_live_tpu"] = live_evidence
         print(json.dumps(art), flush=True)
@@ -1223,6 +1445,14 @@ def main(argv=None) -> int:
         emit()
     for machines, tasks in ladder[1:]:
         run_rung_child(machines, tasks)
+    if args.cluster:
+        # Last on purpose: the cluster-scale rung must never starve the
+        # scored rungs' budget, and its own partial-line protocol means
+        # a timeout still posts the parity verdict + completed rounds.
+        cluster = _stage("cluster", [
+            "--rounds", "1",
+        ] + (["--verbose"] if args.verbose else []), rung_timeout_s() * 2)
+        emit()
     return 0
 
 

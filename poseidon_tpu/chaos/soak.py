@@ -159,6 +159,15 @@ def _metrics_dict(metrics) -> dict:
     return metrics.to_dict()
 
 
+# The solve-tier vocabulary the byte-identity gate accepts.  Every tier
+# of the planner's degraded ladder is legitimate under chaos — including
+# "sharded" (the mesh-split dense solve, certified and deterministic) —
+# but a tier string outside the ladder means the planner and the soak
+# disagree about what ran, which no digest comparison can vouch for.
+_KNOWN_TIERS = ("none", "quiet", "pruned", "dense", "sharded",
+                "host_greedy")
+
+
 def _await(cond: Callable[[], bool], timeout: float) -> bool:
     """Poll ``cond`` until true or deadline.  The watchers' drain
     barrier alone is racy against the watch->KeyedQueue pump (an event
@@ -443,6 +452,13 @@ def run_soak(
             result["lock_contention_ns"] += (
                 lock_contention_ns() - contention0
             )
+            if metrics.solve_tier not in _KNOWN_TIERS:
+                raise SoakFailure(
+                    "unknown-tier",
+                    f"solve_tier {metrics.solve_tier!r} outside the "
+                    f"ladder vocabulary {_KNOWN_TIERS}",
+                    r,
+                )
             result["tiers"].append(metrics.solve_tier)
             result["cost_delta_hits"] += metrics.cost_delta_hits
             digest = _digest(kube_truth)

@@ -41,7 +41,7 @@ from poseidon_tpu.ops.transport import (
 from poseidon_tpu.obs import history as _history
 from poseidon_tpu.obs import profile as _profile
 from poseidon_tpu.obs import trace as _trace
-from poseidon_tpu.utils.hatches import hatch_bool
+from poseidon_tpu.utils.hatches import hatch_bool, hatch_int
 from poseidon_tpu.utils.stagetimer import stage as _stage
 
 
@@ -157,11 +157,22 @@ class RoundMetrics:
     telem_gu_firings: int = 0
     telem_decay_half_life: float = 0.0
     telem_iters_to_90: int = 0
+    # Mesh-sharded band tier (POSEIDON_SHARDED_BANDS): bands this round
+    # served by the sharded solve, the mesh size they ran on, and the
+    # max/mean per-device work ratio read off the dominant sharded
+    # curve's per-shard telemetry lanes (1.0 = perfectly balanced; 0.0
+    # when nothing sharded solved or telemetry was off).  The bench
+    # rung artifact gates these as machine-independent counts.
+    sharded_bands: int = 0
+    shard_devices: int = 0
+    shard_imbalance: float = 0.0
     # Which tier of the degraded-mode ladder served the round (worst
     # band wins): "pruned" (shortlist + full-plane certificate),
-    # "dense" (full-plane solve), "host_greedy" (the last-resort
-    # deterministic host fallback — feasible, atomicity-preserving,
-    # UNCERTIFIED), or "quiet"/"none" for skipped/degenerate rounds.
+    # "dense" (full-plane solve), "sharded" (the mesh-split dense
+    # solve for wide contended bands the pruned gate declines),
+    # "host_greedy" (the last-resort deterministic host fallback —
+    # feasible, atomicity-preserving, UNCERTIFIED), or "quiet"/"none"
+    # for skipped/degenerate rounds.
     solve_tier: str = "none"
     # False when any band's solve exhausted its iteration budget even on a
     # cold retry (gap_bound is then inf and the committed placement is the
@@ -480,6 +491,14 @@ class RoundPlanner:
         self.last_solve_curves: list = []
         # Worst degraded-mode tier used this round (index into _TIERS).
         self._tier_rank = -1
+        # Sharded band tier (POSEIDON_SHARDED_BANDS): per-round count of
+        # bands the mesh-split solve served, the mesh size they ran on,
+        # and the lazily-built tier mesh itself (None = not yet probed;
+        # False = probed, fewer than 2 devices visible).  Distinct from
+        # self._mesh, which backs the solver_devices>1 all-bands config.
+        self._sharded_bands = 0
+        self._shard_devices = 0
+        self._tier_mesh = None
         # Chaos seam (poseidon_tpu/chaos): when set, an object whose
         # ``solver_fault() -> (force_uncertified, partial_fraction)`` is
         # consulted per band — forcing the degraded host-greedy tier
@@ -540,9 +559,15 @@ class RoundPlanner:
     # ---------------------------------------------------------------- solving
 
     def _dispatch_solve(self, costs, supply, capacity, unsched_cost,
-                        prices=None, **kw):
+                        prices=None, sharded_mesh=None, **kw):
         """The one solver dispatch (rounds AND precompile go through it):
-        host ssp, mesh-sharded, or single-chip auction per config."""
+        host ssp, mesh-sharded, or single-chip auction per config.
+
+        ``sharded_mesh`` routes a SINGLE band through the mesh-split
+        kernel without flipping the whole planner to sharded mode — the
+        fourth-tier gate (_sharded_gate) passes the tier mesh here for
+        the wide contended bands it selects.
+        """
         if self.flow_solver == "ssp":
             from poseidon_tpu.ops.transport import TransportSolution
             from poseidon_tpu.solver.oracle import transport_solve
@@ -558,17 +583,21 @@ class RoundPlanner:
                 objective=obj, gap_bound=0.0, iterations=0,
             )
         kw.setdefault("global_update_every", self.global_update_every)
-        if self.solver_devices > 1:
+        if self.solver_devices > 1 or sharded_mesh is not None:
             from poseidon_tpu.ops.transport_sharded import (
                 make_solver_mesh,
                 solve_transport_sharded,
             )
 
-            if self._mesh is None:
-                self._mesh = make_solver_mesh(self.solver_devices)
+            if sharded_mesh is not None:
+                mesh = sharded_mesh
+            else:
+                if self._mesh is None:
+                    self._mesh = make_solver_mesh(self.solver_devices)
+                mesh = self._mesh
             return solve_transport_sharded(
                 costs, supply, capacity, unsched_cost, prices,
-                mesh=self._mesh, **kw,
+                mesh=mesh, **kw,
             )
         from poseidon_tpu.ops.transport import solve_transport_selective
 
@@ -743,6 +772,20 @@ class RoundPlanner:
                             costs, supply, cap, unsched, arc_capacity=arc,
                             max_cost_hint=hint,
                         )
+                        tier_mesh = self._sharded_band_mesh(width)
+                        if tier_mesh is not None:
+                            # The sharded band tier solves the SAME full
+                            # bucket through the mesh-split kernel — its
+                            # own jit program and compile key.  Probe it
+                            # alongside the dense key (both tiers stay
+                            # reachable at runtime: the gate can decline
+                            # or a band can escalate back to dense).
+                            self._dispatch_solve(
+                                costs, supply, cap, unsched,
+                                arc_capacity=arc, max_cost_hint=hint,
+                                sharded_mesh=tier_mesh,
+                            )
+                            compiled += 1
                     compiled += 1
                 e_bucket *= 2
         return compiled
@@ -1232,6 +1275,8 @@ class RoundPlanner:
         self._cost_cols_rebuilt = 0
         self._pipeline_overlap = 0.0
         self._tier_rank = -1
+        self._sharded_bands = 0
+        self._shard_devices = 0
         self._entry_phase_min = -1
         self._phase_iter_sums = None
         self._telem_curves = []
@@ -1363,6 +1408,10 @@ class RoundPlanner:
             metrics.solve_phase_iters = list(self._phase_iter_sums)
         if self._tier_rank >= 0:
             metrics.solve_tier = self._TIERS[self._tier_rank]
+        metrics.sharded_bands = self._sharded_bands
+        metrics.shard_devices = (
+            self._shard_devices if self._sharded_bands else 0
+        )
         self._fold_telemetry(metrics)
         return flows_full
 
@@ -1408,6 +1457,23 @@ class RoundPlanner:
         )
         metrics.telem_decay_half_life = dominant[1].decay_half_life()
         metrics.telem_iters_to_90 = dominant[1].iters_to_drain(0.9)
+        # Shard imbalance: max/mean of per-device total excess over the
+        # dominant SHARDED curve's per-shard lanes (1.0 = balanced).
+        # Work follows excess, so a device whose shard carries most of
+        # the unmet supply is the round's critical path.
+        sharded = [
+            t for _, t in self._telem_curves if t.shard_excess is not None
+        ]
+        if sharded:
+            dom = max(sharded, key=lambda t: t.samples())
+            totals = np.asarray(dom.shard_excess, dtype=np.float64).sum(
+                axis=1
+            )
+            mean = float(totals.mean())
+            if mean > 0.0:
+                metrics.shard_imbalance = round(
+                    float(totals.max()) / mean, 4
+                )
 
     def _maybe_pipeline(self, n_bands: int):
         """The cross-band pipeline, when it can pay: more than one band
@@ -1611,11 +1677,81 @@ class RoundPlanner:
         return flows_full
 
     # The degraded-mode ladder, best tier first.  _note_tier records the
-    # WORST tier any band of the round used.
-    _TIERS = ("pruned", "dense", "host_greedy")
+    # WORST tier any band of the round used.  "sharded" ranks after
+    # "dense": it serves the SAME certified full plane (bit-parity with
+    # the single-chip kernel at gate widths), but splits it over the
+    # device mesh — worse only in the sense that it spends more of the
+    # machine on one band.
+    _TIERS = ("pruned", "dense", "sharded", "host_greedy")
 
     def _note_tier(self, tier: str) -> None:
         self._tier_rank = max(self._tier_rank, self._TIERS.index(tier))
+
+    # ------------------------------------------------- sharded band tier
+
+    def _sharded_tier_mesh(self):
+        """The tier's device mesh over ALL visible devices, built lazily
+        and cached (False = probed, mesh not viable).  Returns the mesh
+        or None."""
+        if self._tier_mesh is None:
+            import jax
+
+            from poseidon_tpu.ops.transport_sharded import make_solver_mesh
+
+            n_dev = len(jax.devices())
+            self._tier_mesh = (
+                make_solver_mesh(n_dev) if n_dev > 1 else False
+            )
+        return self._tier_mesh or None
+
+    def _sharded_band_mesh(self, n_cols: int):
+        """The mesh the sharded tier would solve an ``n_cols``-wide band
+        on, or None when the tier cannot serve that width.  Shared by
+        the production gate and ``precompile`` so both agree on compile
+        keys.
+
+        The width conditions are soundness conditions, not tuning: the
+        mesh path pads columns to a multiple of the device count, and
+        the tier only fires where that rounding is a NO-OP (quarter-
+        octave buckets >= 8192 are multiples of 1024, so this is
+        automatic at the default gate width) — same padded shape, hence
+        same scale, hence warm epsilons and the single-chip bit-parity
+        guarantee carry across tier transitions unchanged.
+        """
+        if (self.flow_solver != "auction" or self.solver_devices != 1
+                or not hatch_bool("POSEIDON_SHARDED_BANDS")):
+            return None
+        if n_cols < hatch_int("POSEIDON_SHARDED_MIN_COLS"):
+            return None
+        mesh = self._sharded_tier_mesh()
+        if mesh is None:
+            return None
+        from poseidon_tpu.ops.transport import padded_shape
+
+        _, m_pad = padded_shape(1, n_cols)
+        if m_pad % mesh.size != 0:
+            return None
+        return mesh
+
+    def _sharded_gate(self, ecs_b, cm, col_cap):
+        """Width x contention gate for the sharded band tier: fires on
+        the wide, contended bands the pruned gate rightly declines (a
+        covering union approaches full width there — PERF round 8), and
+        declines everywhere a single chip is already the right tool.
+        Returns the mesh to solve on, or None."""
+        E, M = cm.costs.shape
+        mesh = self._sharded_band_mesh(M)
+        if mesh is None:
+            return None
+        # Contention: demand as a percentage of open column capacity.
+        # An under-contended band drains in a handful of sweeps on one
+        # chip; splitting it only adds collective latency.
+        supply_sum = int(ecs_b.supply.sum())
+        cap_sum = int(np.asarray(col_cap, dtype=np.int64).sum())
+        if (supply_sum * 100
+                < cap_sum * hatch_int("POSEIDON_SHARDED_MIN_CONTENTION")):
+            return None
+        return mesh
 
     def _solve_host_greedy(self, ecs_b, cm, col_cap, partial_fraction=None):
         """The last rung of the degraded ladder: a deterministic,
@@ -1732,6 +1868,12 @@ class RoundPlanner:
             # attempt's device work then seeds the dense ladder rather
             # than being thrown away (gated with the adaptive ladder:
             # POSEIDON_ADAPTIVE_LADDER=0 restores the exact old restart).
+            # Where the pruned gate declines BECAUSE the band is wide
+            # and contended, the sharded tier picks it up: same full
+            # plane, same warm state (the gate guarantees the mesh's
+            # column padding is a no-op, so the drift epsilon derived
+            # above stays valid), split over the device mesh.
+            shard_mesh = self._sharded_gate(ecs_b, cm, col_cap)
             out = self._solve_plane(
                 ecs_b, cm.costs, col_cap, cm.arc_capacity,
                 cm.unsched_cost, carry_box.get("warm", warm_state),
@@ -1739,8 +1881,14 @@ class RoundPlanner:
                 # the dense solve skips the host-cert pass that would
                 # recompute it and miss.
                 warm_eps_exact="warm" in carry_box,
+                sharded_mesh=shard_mesh,
             )
-            tier = "dense"
+            if shard_mesh is not None:
+                tier = "sharded"
+                self._sharded_bands += 1
+                self._shard_devices = int(shard_mesh.size)
+            else:
+                tier = "dense"
         sol, effective_costs = out
         if sol.gap_bound == float("inf"):
             # Even the dense cold retry exhausted its budget: take the
@@ -2014,7 +2162,8 @@ class RoundPlanner:
 
     def _solve_plane(self, ecs_b, costs, col_cap, arc_capacity,
                      unsched_cost, warm_state, scale=None,
-                     gang_repair=True, warm_eps_exact=False):
+                     gang_repair=True, warm_eps_exact=False,
+                     sharded_mesh=None):
         """The per-plane solve pipeline: coarse warm start, warm/cold
         dispatch with policy budgets, gang-atomicity repair.  Factored
         out of ``_solve_band`` so the pruned path can run the IDENTICAL
@@ -2027,7 +2176,17 @@ class RoundPlanner:
         places it whole), so its repair runs in ``_try_pruned_band``
         on full-plane-certified solutions only.  Returns ``(sol,
         effective_costs)``; ``effective_costs`` is what the final prices
-        are optimal for (gang repair may have forbidden rows)."""
+        are optimal for (gang repair may have forbidden rows).
+
+        ``sharded_mesh`` (the sharded band tier) routes every FULL-plane
+        dispatch of this pipeline — the warm/cold solve and gang-repair
+        re-solves, all the same compile key — through the mesh-split
+        kernel.  The coarse warm start's [E, 256] aggregate stays
+        single-chip (far too narrow to split; its lifted duals warm the
+        sharded full solve exactly as they warm the dense one), and the
+        fused coarse pipeline is declined outright: it is a single-chip
+        jit program whose full-width inner ladder would defeat the
+        split."""
         prices, flows0, unsched0, eps_start = warm_state
         sol = None
         # True when eps_start is the start's EXACT certified epsilon
@@ -2069,6 +2228,7 @@ class RoundPlanner:
             )
             if pre is not None:
                 if (self.solver_devices == 1
+                        and sharded_mesh is None
                         and not pre["certified"]
                         and (scale is None
                              or hatch_bool("POSEIDON_COARSE_PINNED"))
@@ -2133,6 +2293,7 @@ class RoundPlanner:
             is_warm = p is not None or f is not None
             return self._dispatch_solve(
                 run_costs, ecs_b.supply, col_cap, unsched_cost, p,
+                sharded_mesh=sharded_mesh,
                 arc_capacity=arc_capacity, init_flows=f,
                 init_unsched=u, eps_start=eps,
                 max_iter_total=2048 if is_warm else 8192,

@@ -514,7 +514,8 @@ class MetricsServer:
 
 # The degraded-ladder vocabulary (graph/instance.py RoundMetrics
 # .solve_tier): exported one-hot so dashboards can plot tier occupancy.
-SOLVE_TIERS = ("none", "quiet", "pruned", "dense", "host_greedy")
+SOLVE_TIERS = ("none", "quiet", "pruned", "dense", "sharded",
+               "host_greedy")
 
 # RoundMetrics fields that are per-round event counts: also accumulated
 # into process-lifetime counters next to the per-round gauges.

@@ -1778,34 +1778,76 @@ def maybe_greedy_start(greedy_init, init_flows, init_prices, init_unsched,
                                       e_pad, m_pad)
     if scale is None:
         scale = d_scale
+    init_prices = equilibrium_prices(
+        init_flows, leftover, costs=costs, supply=supply,
+        capacity=capacity, arc_capacity=arc_capacity,
+        unsched_cost=unsched_cost, scale=scale,
+    )
+
+    # The exact worst violation of these duals over every arc class —
+    # the same certificate the solver's own gap bound uses.
+    eps_g = _certified_eps(
+        init_flows, init_unsched, init_prices, costs=costs,
+        supply=supply, capacity=capacity, unsched_cost=unsched_cost,
+        scale=scale, arc_capacity=arc_capacity,
+    )
+    # Gate: a dual start above half the cold ladder's eps0 would start
+    # the ladder at (or above) where cold starts anyway — pure noise.
+    # Below that the equilibrium duals measured strictly better or equal
+    # at every scale (10k churn -18% iterations, 10k wave1 659 -> 572,
+    # 1k cold 378 -> 334; the earlier "cold iterations DOUBLED" was the
+    # pre-alternation construction).  The one-scale-unit floor keeps
+    # narrow cost ranges (small max_raw_q) from losing near-exact
+    # starts to the arithmetic.
+    if eps_g > max(scale, max_raw_q * scale // 4):
+        return init_flows, init_unsched, None, None
+    return init_flows, init_unsched, init_prices, eps_g
+
+
+def equilibrium_prices(init_flows, leftover, *, costs, supply, capacity,
+                       arc_capacity, unsched_cost, scale):
+    """Canonical equilibrium duals for a feasible primal state, derived
+    from the FLOWS alone (int32 ``[pe, pm, pt]`` price vector).
+
+    The construction is a pure function of the primal: two equally-
+    optimal flow states produce the same duals, which makes downstream
+    certificate checks robust to WHICH equilibrium a solve landed on
+    (the churn zero-dispatch certificate used to re-solve ~960
+    iterations when the wave picked the "other" optimal dual surface —
+    docs/PERF.md round 9).  Shared by the cold greedy start
+    (``maybe_greedy_start``) and the warm host-certificate retry.
+
+    Machine potentials: a column whose residual arcs undercut row
+    marginals (a machine freed below the fill frontier) prices down by
+    that demand, bounded by the slack of its own loaded arcs (a loaded
+    arc AT its row's marginal pins the column).  This absorbs the
+    column-structured part of the gap — after a churn round the freed
+    machines are cheaper than the frontier for EVERY row, which no
+    row-potential choice can express.
+
+    A few rounds of alternation toward equilibrium duals.  Per column,
+    eps-feasibility is the interval  max_loaded(Cs+pe) <= pm <=
+    min_resid(Cs+pe): loaded arcs need rc = Cs+pe-pm <= 0, residual
+    arcs rc >= 0.  Per row, utility re-prices against the current
+    machine potentials.  Greedy's row-order assignment needs the
+    alternation: an early row that hogged a freed machine pins the
+    column's interval until the row's own utility is re-priced.
+    Conflicting intervals (true contention) keep the loaded bound;
+    the residual violation is then exactly what the certificate and
+    the epsilon ladder resolve.
+
+    Two evaluation engines, identical arithmetic: gathered per-
+    admissible-arc reductions when admissibility is sparse (the
+    constrained rounds whose full-width passes used to dominate the
+    round), full-matrix numpy otherwise.  Loaded and residual arcs
+    are both subsets of the admissible set, so the sparse reductions
+    see every cell the dense masks select.
+    """
+    E, M = costs.shape
+    leftover = np.asarray(leftover, dtype=np.int64)
     BIG = np.int64(1) << 60
     sup64 = supply.astype(np.int64)
     cap64 = capacity.astype(np.int64)
-    # Machine potentials: a column whose residual arcs undercut row
-    # marginals (a machine freed below the fill frontier) prices down by
-    # that demand, bounded by the slack of its own loaded arcs (a loaded
-    # arc AT its row's marginal pins the column).  This absorbs the
-    # column-structured part of the gap — after a churn round the freed
-    # machines are cheaper than the frontier for EVERY row, which no
-    # row-potential choice can express.
-    #
-    # A few rounds of alternation toward equilibrium duals.  Per column,
-    # eps-feasibility is the interval  max_loaded(Cs+pe) <= pm <=
-    # min_resid(Cs+pe): loaded arcs need rc = Cs+pe-pm <= 0, residual
-    # arcs rc >= 0.  Per row, utility re-prices against the current
-    # machine potentials.  Greedy's row-order assignment needs the
-    # alternation: an early row that hogged a freed machine pins the
-    # column's interval until the row's own utility is re-priced.
-    # Conflicting intervals (true contention) keep the loaded bound;
-    # the residual violation is then exactly what the certificate and
-    # the epsilon ladder resolve.
-    #
-    # Two evaluation engines, identical arithmetic: gathered per-
-    # admissible-arc reductions when admissibility is sparse (the
-    # constrained rounds whose full-width passes used to dominate the
-    # round), full-matrix numpy otherwise.  Loaded and residual arcs
-    # are both subsets of the admissible set, so the sparse reductions
-    # see every cell the dense masks select.
     sp = _adm_nonzero(costs)
     if sp is not None:
         r, c = sp
@@ -1885,28 +1927,112 @@ def maybe_greedy_start(greedy_init, init_flows, init_prices, init_unsched,
     # pm - pt >= -eps, so pt sits at their minimum.
     spare = init_flows.sum(axis=0, dtype=np.int64) < cap64
     pt0 = int(pm0[spare].min(initial=0))
-    init_prices = np.concatenate(
-        [pe0, pm0, np.int64([pt0])]
-    ).astype(np.int32)
+    return np.concatenate([pe0, pm0, np.int64([pt0])]).astype(np.int32)
 
-    # The exact worst violation of these duals over every arc class —
-    # the same certificate the solver's own gap bound uses.
-    eps_g = _certified_eps(
-        init_flows, init_unsched, init_prices, costs=costs,
-        supply=supply, capacity=capacity, unsched_cost=unsched_cost,
-        scale=scale, arc_capacity=arc_capacity,
-    )
-    # Gate: a dual start above half the cold ladder's eps0 would start
-    # the ladder at (or above) where cold starts anyway — pure noise.
-    # Below that the equilibrium duals measured strictly better or equal
-    # at every scale (10k churn -18% iterations, 10k wave1 659 -> 572,
-    # 1k cold 378 -> 334; the earlier "cold iterations DOUBLED" was the
-    # pre-alternation construction).  The one-scale-unit floor keeps
-    # narrow cost ranges (small max_raw_q) from losing near-exact
-    # starts to the arithmetic.
-    if eps_g > max(scale, max_raw_q * scale // 4):
-        return init_flows, init_unsched, None, None
-    return init_flows, init_unsched, init_prices, eps_g
+
+def exact_equilibrium_prices(init_flows, leftover, *, costs, supply,
+                             capacity, arc_capacity, unsched_cost, scale,
+                             max_passes=512):
+    """Exact canonical duals for an OPTIMAL primal state, or None.
+
+    Where ``equilibrium_prices`` is a fixed two-pass heuristic tuned to
+    gate cold greedy starts, this is the full normalization the warm
+    host-certificate retry needs: Bellman-Ford shortest-path potentials
+    over the residual graph (rows, columns, sink; forward arcs at
+    ``Cs``, reverse arcs where flow is loaded at ``-Cs``, fallback and
+    sink arcs matching ``_certified_eps``'s conventions exactly).  When
+    the flows are optimal the residual graph has no negative cycle, the
+    relaxation reaches a fixpoint, and the resulting potentials make
+    every residual reduced cost non-negative — an exact certificate by
+    construction, independent of WHICH equally-optimal dual surface the
+    producing solve returned.  A pure, deterministic function of the
+    primal: two equally-optimal flow states yield the same potentials.
+
+    Returns None when the relaxation has not stabilised within
+    ``max_passes`` (a non-optimal primal, or an adversarially long
+    shortest-path tree) — callers keep whatever certificate the shipped
+    duals earned.  Each pass is one O(E*M) min-reduction (gathered
+    per-admissible-arc on sparse-admissibility rounds); warm steady
+    states stabilise in a handful of passes.
+    """
+    E, M = costs.shape
+    leftover = np.asarray(leftover, dtype=np.int64)
+    sup64 = supply.astype(np.int64)
+    cap64 = capacity.astype(np.int64)
+    us_s = unsched_cost.astype(np.int64) * scale
+    fb_loaded = leftover > 0
+    fb_resid = sup64 - leftover > 0
+    d_e = np.zeros(E, dtype=np.int64)
+    d_m = np.zeros(M, dtype=np.int64)
+    d_t = np.int64(0)
+    sp = _adm_nonzero(costs)
+    if sp is not None:
+        r, c = sp
+        Cs_v = costs[r, c].astype(np.int64) * scale
+        fl_v = init_flows[r, c].astype(np.int64)
+        uem_v = np.minimum(sup64[r], cap64[c])
+        if arc_capacity is not None:
+            uem_v = np.minimum(uem_v, arc_capacity[r, c].astype(np.int64))
+        fwd_v = uem_v - fl_v > 0
+        rev_v = fl_v > 0
+        rf, cf, Cf = r[fwd_v], c[fwd_v], Cs_v[fwd_v]
+        rr, cr, Cr = r[rev_v], c[rev_v], Cs_v[rev_v]
+        fmt = init_flows.sum(axis=0, dtype=np.int64)
+        mt_resid = cap64 - fmt > 0
+        mt_loaded = fmt > 0
+        for _ in range(max_passes):
+            pe_prev, pm_prev, pt_prev = d_e.copy(), d_m.copy(), d_t
+            np.minimum.at(d_m, cf, Cf + d_e[rf])
+            np.minimum.at(d_e, rr, d_m[cr] - Cr)
+            if fb_resid.any():
+                d_t = min(d_t, np.int64((us_s + d_e)[fb_resid].min()))
+            d_e = np.where(fb_loaded, np.minimum(d_e, d_t - us_s), d_e)
+            if mt_resid.any():
+                d_t = min(d_t, np.int64(d_m[mt_resid].min()))
+            d_m = np.where(mt_loaded, np.minimum(d_m, d_t), d_m)
+            if (d_t == pt_prev and np.array_equal(d_e, pe_prev)
+                    and np.array_equal(d_m, pm_prev)):
+                break
+        else:
+            return None
+    else:
+        C64 = costs.astype(np.int64)
+        adm = costs < INF_COST
+        Uem = np.minimum(sup64[:, None], cap64[None, :])
+        if arc_capacity is not None:
+            Uem = np.minimum(Uem, arc_capacity.astype(np.int64))
+        fl = init_flows.astype(np.int64)
+        BIG = np.int64(1) << 60
+        Cs_fwd = np.where(adm & (Uem - fl > 0), C64 * scale, BIG)
+        Cs_rev = np.where(adm & (fl > 0), C64 * scale, -BIG)
+        fmt = fl.sum(axis=0)
+        mt_resid = cap64 - fmt > 0
+        mt_loaded = fmt > 0
+        for _ in range(max_passes):
+            pe_prev, pm_prev, pt_prev = d_e, d_m, d_t
+            d_m = np.minimum(d_m, (Cs_fwd + d_e[:, None]).min(axis=0))
+            d_e = np.minimum(d_e, (d_m[None, :] - Cs_rev).min(axis=1))
+            if fb_resid.any():
+                d_t = min(d_t, np.int64((us_s + d_e)[fb_resid].min()))
+            d_e = np.where(fb_loaded, np.minimum(d_e, d_t - us_s), d_e)
+            if mt_resid.any():
+                d_t = min(d_t, np.int64(d_m[mt_resid].min()))
+            d_m = np.where(mt_loaded, np.minimum(d_m, d_t), d_m)
+            if (d_t == pt_prev and np.array_equal(d_e, pe_prev)
+                    and np.array_equal(d_m, pm_prev)):
+                break
+        else:
+            return None
+    # Anchor at max=0 (potentials are shift-invariant) so the spread cap
+    # clips only genuinely wide surfaces; a clipped surface simply fails
+    # the certificate re-check and the caller keeps the original.
+    top = np.int64(max(int(d_e.max()), int(d_m.max()), int(d_t)))
+    d_e, d_m, d_t = d_e - top, d_m - top, d_t - top
+    lo_cap = -(PRICE_SPREAD_CAP - 1)
+    d_e = np.clip(d_e, lo_cap, None)
+    d_m = np.clip(d_m, lo_cap, None)
+    d_t = max(d_t, np.int64(lo_cap))
+    return np.concatenate([d_e, d_m, np.int64([d_t])]).astype(np.int32)
 
 
 def normalize_prices(p: np.ndarray) -> np.ndarray:
@@ -2371,6 +2497,39 @@ def solve_transport(
                     unsched_cost=unsched_cost, scale=scale, clean=True,
                     arc_capacity=arc_capacity,
                 )
+            if (
+                cand is not None
+                and not on_forbidden
+                and 0.0 < cand.gap_bound < float("inf")
+            ):
+                # Equilibrium-robust retry: equally-optimal solves agree
+                # on the FLOWS but not on which dual surface they return,
+                # and the certificate above checks the shipped duals —
+                # so a wave that landed on the "other" equilibrium made
+                # the next churn round's exact-cert miss and re-solve
+                # ~960 iterations for an unchanged optimum (docs/PERF.md
+                # round 9, one churn round in five).  Re-deriving
+                # CANONICAL duals from the primal alone and certifying
+                # those makes the outcome a function of the flows only.
+                # The flows are untouched, so an accept changes neither
+                # placements nor objective; a miss keeps the ORIGINAL
+                # candidate (its eps_certified describes the prices the
+                # solve will actually start from — the adaptive ladder
+                # entry below needs exactly that).
+                canonical = exact_equilibrium_prices(
+                    init_flows, init_unsched, costs=costs, supply=supply,
+                    capacity=capacity, arc_capacity=arc_capacity,
+                    unsched_cost=unsched_cost, scale=scale,
+                )
+                if canonical is not None:
+                    cand2 = _host_finalize(
+                        init_flows, init_unsched, canonical, 0,
+                        costs=costs, supply=supply, capacity=capacity,
+                        unsched_cost=unsched_cost, scale=scale,
+                        clean=True, arc_capacity=arc_capacity,
+                    )
+                    if cand2 is not None and cand2.gap_bound == 0.0:
+                        cand = cand2
         if cand is not None and cand.gap_bound == 0.0:
             _Telemetry.host_cert_returns += 1
             # Callers own their return value; without a repair the

@@ -154,3 +154,49 @@ def test_wave_rung_smoke_warm_rounds_compile_free():
     assert m_wave.implicit_transfers == 0
     assert m_churn.implicit_transfers == 0
     assert "implicit_transfers" in m_churn.to_dict()
+
+
+def test_sharded_mesh_rung_warm_budget0(monkeypatch):
+    """Tiny mesh rung: a warm SHARDED band round must hold both ledgers
+    at budget 0 — the mesh-split kernel is a first-class citizen of the
+    compile-key ladder and the transfer discipline, not a special case
+    (conftest forces 8 virtual CPU devices, so the tier mesh is live
+    everywhere this suite runs, including ``make bench-smoke``)."""
+    import numpy as np
+
+    import bench
+    from poseidon_tpu.check.ledger import (
+        CompileLedger,
+        TransferLedger,
+    )
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+    from poseidon_tpu.graph.state import ClusterState, MachineInfo
+
+    monkeypatch.setenv("POSEIDON_SHARDED_BANDS", "1")
+    monkeypatch.setenv("POSEIDON_SHARDED_MIN_COLS", "64")
+    monkeypatch.setenv("POSEIDON_SHARDED_MIN_CONTENTION", "1")
+
+    # 64 machines: a quarter-octave bucket the 8-device mesh divides.
+    state = ClusterState()
+    rng = np.random.default_rng(0)
+    for i in range(64):
+        state.node_added(MachineInfo(
+            uuid=f"mr-m{i}", cpu_capacity=int(rng.integers(4000, 16000)),
+            ram_capacity=1 << 24, task_slots=8,
+        ))
+    bench.submit_population(state, 600, 8, seed=0)
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+    _, m_cold = planner.schedule_round()  # cold: compiles expected
+    assert m_cold.solve_tier == "sharded", m_cold.solve_tier
+    planner.precompile(max_ecs=8)
+
+    bench.churn_step(state, rng, frac=50)
+    with CompileLedger(budget=0, label="warm sharded round"), \
+            TransferLedger(budget=0, label="warm sharded round"):
+        _, m = planner.schedule_round()
+    assert m.solve_tier == "sharded"
+    assert m.sharded_bands >= 1 and m.shard_devices == 8
+    assert m.converged and m.gap_bound == 0.0
+    assert m.fresh_compiles == 0
+    assert m.implicit_transfers == 0

@@ -1,0 +1,357 @@
+"""Planner-level sharded band tier (POSEIDON_SHARDED_BANDS).
+
+The fourth rung of the solve ladder — pruned -> dense -> sharded ->
+host_greedy — mesh-splits wide contended bands over the visible device
+mesh.  These tests pin its planner-level contract: the gate's fire and
+decline behavior, randomized sharded-vs-dense parity (placements AND
+objective — the mesh padding is a no-op at gate widths, so the kernel
+is bit-identical to single-chip), warm-start soundness across tier
+transitions in BOTH directions, the telemetry ride-through (wire format
+-> /metrics -> soak/bench sub-reports), and the equilibrium-robust
+churn certificate (satellite: docs/PERF.md round 9's one-in-five
+~960-iteration churn re-solve).
+
+conftest.py forces 8 virtual CPU devices, so the tier mesh is always
+available here.
+"""
+
+import numpy as np
+import pytest
+
+
+def _contended_state(machines=64, seed=5, tasks=600):
+    """A wide-for-test-scale contended cluster: 64 machines is a
+    quarter-octave bucket divisible by the 8-device mesh, and demand
+    near capacity keeps the solve off the trivial host-cert path."""
+    from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+    from poseidon_tpu.utils.ids import task_uid
+
+    state = ClusterState()
+    rng = np.random.default_rng(seed)
+    for i in range(machines):
+        state.node_added(MachineInfo(
+            uuid=f"sh-m{i}", cpu_capacity=int(rng.integers(4000, 16000)),
+            ram_capacity=1 << 24, task_slots=6,
+        ))
+    for i in range(tasks):
+        state.task_submitted(TaskInfo(
+            uid=task_uid(f"sh{seed}", i), job_id=f"j{i % 8}",
+            cpu_request=int(rng.integers(400, 2000)),
+            ram_request=1 << 18,
+        ))
+    return state
+
+
+def _tier_on(monkeypatch, min_cols="64", min_contention="1"):
+    monkeypatch.setenv("POSEIDON_SHARDED_BANDS", "1")
+    monkeypatch.setenv("POSEIDON_SHARDED_MIN_COLS", min_cols)
+    monkeypatch.setenv("POSEIDON_SHARDED_MIN_CONTENTION", min_contention)
+
+
+def _planner(state):
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+
+    return RoundPlanner(state, get_cost_model("cpu_mem"))
+
+
+def _delta_view(deltas):
+    return sorted((int(d.type), int(d.task_id), d.resource_id)
+                  for d in deltas)
+
+
+def test_sharded_tier_serves_contended_band(monkeypatch):
+    _tier_on(monkeypatch)
+    planner = _planner(_contended_state())
+    _, m = planner.schedule_round()
+    assert m.solve_tier == "sharded"
+    assert m.sharded_bands >= 1
+    assert m.shard_devices == 8
+    assert m.converged and m.gap_bound == 0.0
+    assert m.placed > 0
+    # The per-shard work lanes reached the round's telemetry fold.
+    assert m.shard_imbalance >= 1.0
+    # And the curves ring carries the per-shard lanes for the round
+    # history / flight recorder.
+    assert any(c.get("shard_excess") for c in planner.last_solve_curves)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_sharded_vs_dense_parity_randomized(monkeypatch, seed):
+    """Same cluster, tier on vs off: identical placements (delta view),
+    objective, and iteration count — the mesh solve at gate widths is
+    the single-chip solve, split."""
+    _tier_on(monkeypatch)
+    d_sh, m_sh = _planner(_contended_state(seed=seed)).schedule_round()
+    monkeypatch.setenv("POSEIDON_SHARDED_BANDS", "0")
+    d_dn, m_dn = _planner(_contended_state(seed=seed)).schedule_round()
+    assert m_sh.solve_tier == "sharded"
+    assert m_dn.solve_tier in ("pruned", "dense")
+    assert m_sh.objective == m_dn.objective
+    assert m_sh.placed == m_dn.placed
+    assert m_sh.iterations == m_dn.iterations
+    assert _delta_view(d_sh) == _delta_view(d_dn)
+
+
+def test_sharded_gate_declines_are_bit_identical(monkeypatch):
+    """Hatch ON with the tier gated off (width below MIN_COLS) must be
+    indistinguishable from hatch OFF — the gate declining IS the
+    production default at under-sized/under-contended widths."""
+    _tier_on(monkeypatch, min_cols="100000")
+    d_on, m_on = _planner(_contended_state(seed=9)).schedule_round()
+    monkeypatch.setenv("POSEIDON_SHARDED_BANDS", "0")
+    d_off, m_off = _planner(_contended_state(seed=9)).schedule_round()
+    assert m_on.solve_tier != "sharded"
+    assert m_on.sharded_bands == 0 and m_on.shard_devices == 0
+    assert m_on.solve_tier == m_off.solve_tier
+    assert m_on.objective == m_off.objective
+    assert m_on.iterations == m_off.iterations
+    assert _delta_view(d_on) == _delta_view(d_off)
+
+
+def test_sharded_gate_declines_under_contention(monkeypatch):
+    """An under-contended band (demand below the threshold relative to
+    capacity) stays dense even with the width gate satisfied.  This
+    cluster runs ~156% contended, so a 1000% threshold must decline."""
+    _tier_on(monkeypatch, min_contention="1000")
+    _, m = _planner(_contended_state(seed=3)).schedule_round()
+    assert m.solve_tier != "sharded"
+    assert m.sharded_bands == 0
+
+
+def test_tier_transition_warm_start_both_directions(monkeypatch):
+    """Prices must survive tier transitions in both directions: a warm
+    frame saved by a sharded round serves the next dense round, and
+    vice versa — the mesh padding no-op at gate widths keeps the drift
+    epsilon valid across the switch."""
+    import bench
+
+    _tier_on(monkeypatch)
+    state = _contended_state(seed=11)
+    planner = _planner(state)
+    _, m1 = planner.schedule_round()
+    assert m1.solve_tier == "sharded" and m1.gap_bound == 0.0
+    assert planner._warm_bands, "sharded round saved no warm frame"
+    cold_iters = m1.iterations
+
+    rng = np.random.default_rng(2)
+    # sharded -> sharded (the steady state), then sharded -> dense,
+    # then dense -> sharded.  Every warm round must certify exactly and
+    # cost at most the cold solve (a dropped/poisoned carried frame
+    # shows up as a full re-derivation or a failed certificate).
+    for flip_to in ("1", "0", "1"):
+        monkeypatch.setenv("POSEIDON_SHARDED_BANDS", flip_to)
+        bench.churn_step(state, rng)
+        _, m = planner.schedule_round()
+        expected = "sharded" if flip_to == "1" else ("pruned", "dense")
+        if flip_to == "1":
+            assert m.solve_tier == expected
+        else:
+            assert m.solve_tier in expected
+        assert m.converged and m.gap_bound == 0.0
+        assert m.iterations <= cold_iters, (
+            f"warm round after tier flip to {flip_to!r} cost "
+            f"{m.iterations} iterations vs {cold_iters} cold"
+        )
+        assert planner._warm_bands
+
+
+def test_solve_tier_sharded_telemetry_ride_through():
+    """RoundMetrics.solve_tier == "sharded" and the shard series ride
+    the single wire format end to end: to_dict/from_dict, the /metrics
+    one-hot + schema gauges, and the soak/bench sub-report vocabulary."""
+    from poseidon_tpu.chaos import soak
+    from poseidon_tpu.graph.instance import RoundMetrics
+    from poseidon_tpu.obs import metrics as obs_metrics
+
+    m = RoundMetrics(round_index=3, solve_tier="sharded",
+                     sharded_bands=2, shard_devices=8,
+                     shard_imbalance=1.25, placed=7)
+    d = m.to_dict()
+    assert d["solve_tier"] == "sharded"
+    assert d["sharded_bands"] == 2
+    assert d["shard_devices"] == 8
+    assert d["shard_imbalance"] == 1.25
+    rt = RoundMetrics.from_dict(d)
+    assert (rt.solve_tier, rt.sharded_bands, rt.shard_devices,
+            rt.shard_imbalance) == ("sharded", 2, 8, 1.25)
+
+    assert "sharded" in obs_metrics.SOLVE_TIERS
+    reg = obs_metrics.Registry()
+    obs_metrics.observe_round(m, registry=reg)
+    text = reg.expose()
+    assert 'poseidon_round_solve_tier{tier="sharded"} 1' in text
+    assert 'poseidon_round_solve_tier{tier="dense"} 0' in text
+    assert "poseidon_round_sharded_bands 2" in text
+    assert "poseidon_round_shard_devices 8" in text
+    assert "poseidon_round_shard_imbalance 1.25" in text
+
+    # The soak's byte-identity gate accepts the tier (its sub-reports
+    # are the same to_dict wire format).
+    assert "sharded" in soak._KNOWN_TIERS
+    assert soak._metrics_dict(m)["solve_tier"] == "sharded"
+
+
+def test_bench_artifact_lifts_shard_series():
+    """build_artifact lifts the sharded series + tier fingerprint of
+    the scored rung top-level (bench_compare reads them there)."""
+    import bench
+
+    rung = {
+        "machines": 100, "tasks": 1000, "ok": True, "converged": True,
+        "cold_s": 1.0, "wave_p50_s": 0.5, "churn_p50_s": 0.1,
+        "wave_solve_iters": [10], "wave_sharded_bands": [1],
+        "wave_shard_imbalance": [1.1], "solve_tiers": ["sharded"],
+    }
+    art = bench.build_artifact(
+        [rung], (100, 1000), {"parity_ok": True}, {}, {},
+        cluster={"ok": True, "sharded_parity_ok": True},
+    )
+    assert art["wave_sharded_bands"] == [1]
+    assert art["wave_shard_imbalance"] == [1.1]
+    assert art["solve_tiers"] == ["sharded"]
+    assert art["cluster"]["sharded_parity_ok"] is True
+
+
+def test_bench_compare_flags_tier_mismatch():
+    """Satellite bugfix: a sharded-tier current vs a single-chip
+    baseline must be flagged apples-to-oranges, not silently diffed;
+    artifacts predating solve_tiers stay comparable (single-chip by
+    construction)."""
+    import sys
+
+    sys.path.insert(0, "tools")
+    try:
+        import bench_compare
+    finally:
+        sys.path.pop(0)
+
+    base = {"backend": "cpu", "machines": 100, "tasks": 1000,
+            "wave_p50_s": 0.5, "wave_solve_iters": [10]}
+    cur_sharded = dict(base, solve_tiers=["quiet", "sharded"])
+    out = bench_compare.compare(base, cur_sharded)
+    assert not out["comparable"]
+    assert "solver-tier mismatch" in out["reason"]
+
+    # Pre-field baseline vs single-chip current: still comparable.
+    cur_single = dict(base, solve_tiers=["dense", "quiet"])
+    assert bench_compare.compare(base, cur_single)["comparable"]
+    # Sharded on both sides: comparable again.
+    assert bench_compare.compare(
+        cur_sharded, dict(cur_sharded))["comparable"]
+
+
+def test_precompile_covers_sharded_tier_key(monkeypatch):
+    """With the hatch on, precompile probes the mesh-split kernel at
+    the full bucket, so a warm sharded round mints no fresh compile
+    (the bench-smoke mesh rung pins the ledger side; this pins the
+    compile-count side)."""
+    _tier_on(monkeypatch)
+    from poseidon_tpu.check.ledger import CompileLedger
+
+    state = _contended_state(seed=21)
+    planner = _planner(state)
+    planner.precompile(max_ecs=8)
+    with CompileLedger(budget=0, label="post-precompile sharded round"):
+        _, m = planner.schedule_round()
+    assert m.solve_tier == "sharded"
+    assert m.fresh_compiles == 0
+
+
+def test_cert_robust_to_equilibrium_choice():
+    """Satellite regression (docs/PERF.md round 9): the zero-dispatch
+    churn certificate must not depend on WHICH equally-optimal dual
+    surface the previous solve returned.  A warm start whose flows are
+    exactly optimal but whose duals are a perturbed (still spread-
+    capped) equilibrium used to miss the exact certificate and
+    re-solve ~960 iterations; the canonical-duals retry re-derives the
+    prices from the primal and returns in zero iterations."""
+    from poseidon_tpu.ops.transport import (
+        _certified_eps,
+        derive_scale,
+        padded_shape,
+        solve_transport,
+    )
+
+    rng = np.random.default_rng(42)
+    E, M = 6, 16
+    costs = rng.integers(1, 50, size=(E, M)).astype(np.int32)
+    supply = rng.integers(1, 4, size=E).astype(np.int32)
+    capacity = np.full(M, 2, dtype=np.int32)
+    unsched_cost = np.full(E, 100, dtype=np.int32)
+
+    sol = solve_transport(costs, supply, capacity, unsched_cost)
+    assert sol.gap_bound == 0.0
+
+    e_pad, m_pad = padded_shape(E, M)
+    scale, _ = derive_scale(costs, unsched_cost, 0, e_pad, m_pad)
+    # The "other" equilibrium: perturb one row potential.  The FLOWS
+    # stay exactly optimal; only the dual surface moved, which is
+    # precisely what a different-but-equally-optimal wave solve hands
+    # the next churn round.
+    perturbed = sol.prices.copy()
+    perturbed[0] -= 2 * scale
+    eps_perturbed = _certified_eps(
+        sol.flows, sol.unsched, perturbed, costs=costs, supply=supply,
+        capacity=capacity, unsched_cost=unsched_cost, scale=scale,
+    )
+    assert eps_perturbed > 1, (
+        "perturbation failed to break the exact certificate — the "
+        "regression scenario needs a cert-missing equilibrium"
+    )
+
+    warm = solve_transport(
+        costs, supply, capacity, unsched_cost, perturbed,
+        init_flows=sol.flows, init_unsched=sol.unsched,
+    )
+    assert warm.iterations == 0, (
+        f"equilibrium flip re-dispatched: {warm.iterations} iterations"
+    )
+    assert warm.gap_bound == 0.0
+    assert warm.objective == sol.objective
+    assert np.array_equal(warm.flows, sol.flows)
+
+
+def test_exact_equilibrium_prices_certify_any_optimal_primal():
+    """The canonical-dual reconstruction depends on the primal alone
+    (feeding it a dual surface is impossible by signature), is
+    deterministic, and certifies an optimal primal EXACTLY across many
+    random instances — the property the host-cert retry leans on."""
+    from poseidon_tpu.ops.transport import (
+        _certified_eps,
+        derive_scale,
+        exact_equilibrium_prices,
+        padded_shape,
+        solve_transport,
+    )
+
+    for seed in range(8):
+        rng = np.random.default_rng(seed)
+        E = int(rng.integers(3, 8))
+        M = int(rng.integers(8, 20))
+        costs = rng.integers(1, 30, size=(E, M)).astype(np.int32)
+        supply = rng.integers(1, 3, size=E).astype(np.int32)
+        capacity = np.full(M, 2, dtype=np.int32)
+        unsched_cost = np.full(E, 64, dtype=np.int32)
+        sol = solve_transport(costs, supply, capacity, unsched_cost)
+        assert sol.gap_bound == 0.0
+        e_pad, m_pad = padded_shape(E, M)
+        scale, _ = derive_scale(costs, unsched_cost, 0, e_pad, m_pad)
+        p1 = exact_equilibrium_prices(
+            sol.flows, sol.unsched, costs=costs, supply=supply,
+            capacity=capacity, arc_capacity=None,
+            unsched_cost=unsched_cost, scale=scale,
+        )
+        assert p1 is not None, f"seed {seed}: relaxation did not settle"
+        assert p1.shape == (E + M + 1,)
+        p2 = exact_equilibrium_prices(
+            sol.flows, sol.unsched, costs=costs, supply=supply,
+            capacity=capacity, arc_capacity=None,
+            unsched_cost=unsched_cost, scale=scale,
+        )
+        assert np.array_equal(p1, p2)
+        eps = _certified_eps(
+            sol.flows, sol.unsched, p1, costs=costs, supply=supply,
+            capacity=capacity, unsched_cost=unsched_cost, scale=scale,
+        )
+        assert eps == 1, f"seed {seed}: canonical duals eps {eps}"

@@ -119,6 +119,20 @@ def _config_key(art: dict) -> Tuple:
     )
 
 
+def _solver_key(art: dict) -> str:
+    """Solver-tier fingerprint for the comparability guard: a rung any
+    of whose rounds the SHARDED tier served splits device work over a
+    mesh, so its per-round count series (iterations, dispatches,
+    per-shard lanes) are not commensurable with a single-chip rung's.
+    Artifacts predating the ``solve_tiers`` field are single-chip by
+    construction (the tier shipped with the field), so absence means
+    "single"."""
+    tiers = art.get("solve_tiers")
+    if isinstance(tiers, (list, tuple)) and "sharded" in tiers:
+        return "sharded"
+    return "single"
+
+
 def collect_timings(art: dict) -> Dict[str, float]:
     """Flatten an artifact's timing series to {dotted_name: seconds}.
 
@@ -197,6 +211,18 @@ def compare(
             "reason": (
                 f"config mismatch: baseline {base_key} vs current "
                 f"{cur_key} (backend/machines/tasks must match)"
+            ),
+            "rows": [], "skipped": [], "regressions": [],
+        }
+    base_solver, cur_solver = _solver_key(baseline), _solver_key(current)
+    if base_solver != cur_solver:
+        return {
+            "comparable": False,
+            "reason": (
+                f"solver-tier mismatch: baseline {base_solver} vs "
+                f"current {cur_solver} — a sharded-tier rung splits "
+                "device work over a mesh, so its count series are "
+                "apples-to-oranges against single-chip counts"
             ),
             "rows": [], "skipped": [], "regressions": [],
         }
