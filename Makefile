@@ -5,8 +5,8 @@
 PY ?= python
 
 .PHONY: all test test-fast bench protos native verify lint lint-fast \
-  bench-smoke soak-smoke trace-smoke profile-smoke perf-gate demo \
-  demo-stop clean
+  bench-smoke soak-smoke trace-smoke profile-smoke throughput-smoke \
+  perf-gate demo demo-stop clean
 
 all: protos native lint test
 
@@ -53,6 +53,13 @@ trace-smoke:
 # CompileLedger(budget=0) and TransferLedger(budget=0).
 profile-smoke:
 	$(PY) tools/profile_smoke.py
+
+# Streaming-throughput smoke (docs/PERF.md round 11): a tiny fixed-
+# duration run of the sustained-throughput rung through the full stack
+# — placements/sec > 0 in both modes, fixed-round streaming-vs-
+# synchronous kube truth byte-identical, warm windows compile-free.
+throughput-smoke:
+	$(PY) -m pytest tests/test_throughput_smoke.py -q -m slow -p no:cacheprovider
 
 # Perf-regression gate (tools/bench_compare.py): diff a fresh bench
 # artifact's timing series (headline p50s + per-stage features timings)
@@ -143,7 +150,8 @@ lint-fast:
 # baseline is judged against its predecessors — either way a regression
 # past the band fails verify.  POSEIDON_PERF_GATE=warn downgrades to
 # warn-only on known-noisy machines.
-verify: lint bench-smoke soak-smoke trace-smoke profile-smoke perf-gate
+verify: lint bench-smoke soak-smoke trace-smoke profile-smoke \
+  throughput-smoke perf-gate
 	$(PY) __graft_entry__.py
 
 # Backgrounded demo loop with its PID on record (out/demo.pid), so the

@@ -908,6 +908,248 @@ def run_soak(machines: int, rounds: int, plan: str, seed: int) -> dict:
     return out
 
 
+def _throughput_session(machines: int, seed: int, streaming: bool, *,
+                        seconds: float = 0.0, fixed_rounds: int = 0,
+                        pods_per_round: int = 24) -> dict:
+    """One full-stack continuous-churn session (no faults): FakeKube +
+    watchers + glue loop + Firmament service, driven either for a fixed
+    DURATION (``seconds`` — the throughput leg: churn and round as fast
+    as the engine completes them) or for a fixed ROUND COUNT
+    (``fixed_rounds`` — the byte-identity leg: every round drained
+    before the next so streaming and synchronous runs see identical
+    admitted sets and must place identically).
+
+    Flips POSEIDON_STREAMING for the session and restores it — callers
+    run back-to-back streaming/synchronous legs in one child process."""
+    import numpy as np
+
+    from poseidon_tpu.chaos.soak import (
+        _NODE_CPU,
+        _NODE_RAM,
+        _POD_SHAPES,
+        _await,
+        _digest,
+        _placement_views,
+    )
+    from poseidon_tpu.check.ledger import fresh_compile_count
+    from poseidon_tpu.glue.fake_kube import FakeKube, Node, Pod
+    from poseidon_tpu.glue.poseidon import Poseidon
+    from poseidon_tpu.ops.transport import bucket_size
+    from poseidon_tpu.service.server import FirmamentTPUServer
+    from poseidon_tpu.utils.config import FirmamentTPUConfig, PoseidonConfig
+
+    # Save/restore of the raw env slot, not a semantic read — the
+    # engine itself reads the flag through the hatch registry.
+    prev = os.environ.get("POSEIDON_STREAMING")  # posecheck: ignore[hatch-registry]
+    os.environ["POSEIDON_STREAMING"] = "1" if streaming else "0"
+    server = poseidon = None
+    try:
+        server = FirmamentTPUServer(
+            address="127.0.0.1:0",
+            config=FirmamentTPUConfig(
+                precompile=True,
+                max_ecs=bucket_size(len(_POD_SHAPES) * 4, lo=8),
+                max_machines=0,
+            ),
+        ).start()
+        kube = FakeKube()
+        cfg = PoseidonConfig(
+            firmament_address=server.address,
+            scheduling_interval=3600,
+            crash_loop_budget=4,
+            crash_backoff_s=0.01,
+            crash_backoff_max_s=0.05,
+        )
+        poseidon = Poseidon(
+            kube, config=cfg, run_loop=False
+        ).start(health_timeout=30)
+        for i in range(machines):
+            kube.add_node(Node(
+                name=f"m{i:04d}",
+                cpu_capacity=_NODE_CPU, ram_capacity=_NODE_RAM,
+            ))
+        synced = _await(
+            lambda: all(
+                poseidon.shared.get_node(f"m{i:04d}") is not None
+                for i in range(machines)
+            ),
+            30.0,
+        )
+        if not (synced and poseidon.drain_watchers(timeout=30.0)):
+            return {"ok": False, "error": "node sync never drained"}
+        server.servicer.ensure_precompiled()
+
+        rng = np.random.default_rng(seed)
+        counter = 0
+
+        def churn() -> list:
+            """This round's workload: create a cohort, complete the
+            oldest Running half-cohort (bounded live population)."""
+            nonlocal counter
+            created = []
+            for _ in range(pods_per_round):
+                cpu, ram = _POD_SHAPES[int(rng.integers(len(_POD_SHAPES)))]
+                name = f"tp-{counter:06d}"
+                counter += 1
+                kube.create_pod(Pod(
+                    name=name, cpu_request=cpu, ram_request=ram,
+                    owner_uid=f"tpjob-{counter % 7}",
+                ))
+                created.append(f"default/{name}")
+            # Snapshot copy (list_pods) — the streaming enact worker
+            # mutates the live registry concurrently in the duration leg.
+            running = sorted(
+                p.key for p in kube.list_pods() if p.phase == "Running"
+            )
+            for key in running[:pods_per_round // 2]:
+                kube.set_pod_phase(key, "Succeeded")
+            return created
+
+        rounds = 0
+        staleness: list = []
+        overlaps: list = []
+        deferred = 0
+        digests: list = []
+        warm_fresh = 0
+        fresh_mark = None
+        t0 = time.perf_counter()
+        deadline = t0 + seconds if seconds else None
+        while True:
+            if deadline is not None and time.perf_counter() >= deadline:
+                break
+            if fixed_rounds and rounds >= fixed_rounds:
+                break
+            created = churn()
+            if fixed_rounds:
+                # Identity leg only: barrier every delta into the view
+                # before the cut, so both modes admit identical sets.
+                _await(
+                    lambda: all(
+                        poseidon.shared.uid_for_pod(k) is not None
+                        for k in created
+                    ),
+                    10.0,
+                )
+                poseidon.drain_watchers(timeout=10.0)
+            delay = poseidon.try_round()
+            if delay is None:
+                return {"ok": False, "error": poseidon.fatal}
+            rounds += 1
+            m = server.servicer.planner.last_metrics
+            if m is not None:
+                staleness.append(float(m.admission_staleness_s))
+                overlaps.append(float(m.overlap_fraction))
+                deferred += int(m.admission_deferred)
+            if fixed_rounds:
+                if not poseidon.drain_rounds(timeout=30.0):
+                    return {"ok": False, "error": "enact never drained"}
+                poseidon.drain_watchers(timeout=10.0)
+                kube_truth, sched_view = _placement_views(
+                    kube, poseidon, server
+                )
+                if kube_truth != sched_view:
+                    return {
+                        "ok": False, "error": f"divergence at round {rounds}",
+                    }
+                digests.append(_digest(kube_truth))
+            if rounds == 2:
+                # Warm window opens after the engine has seen both the
+                # wave and churn shapes once.
+                fresh_mark = fresh_compile_count()
+        wall = time.perf_counter() - t0
+        if not poseidon.drain_rounds(timeout=60.0):
+            return {"ok": False, "error": "final enactment never drained"}
+        poseidon.drain_watchers(timeout=30.0)
+        if fresh_mark is not None:
+            warm_fresh = fresh_compile_count() - fresh_mark
+        placed = poseidon.loop_stats.placed
+        out = {
+            "ok": True,
+            "mode": "streaming" if streaming else "synchronous",
+            "rounds": rounds,
+            "placed": int(placed),
+            "wall_s": round(wall, 3),
+            "placements_per_sec": (
+                round(placed / wall, 2) if wall > 0 else 0.0
+            ),
+            "overlap_fraction_mean": (
+                round(float(np.mean(overlaps)), 4) if overlaps else 0.0
+            ),
+            "admission_staleness_p50_s": (
+                round(float(np.percentile(staleness, 50)), 6)
+                if staleness else 0.0
+            ),
+            "admission_staleness_p99_s": (
+                round(float(np.percentile(staleness, 99)), 6)
+                if staleness else 0.0
+            ),
+            "admission_deferred_total": int(deferred),
+            "warm_fresh_compiles": int(warm_fresh),
+            "digests": digests,
+        }
+        return out
+    finally:
+        if poseidon is not None:
+            poseidon.stop()
+        if server is not None:
+            server.stop(grace=0.5)
+        if prev is None:
+            os.environ.pop("POSEIDON_STREAMING", None)
+        else:
+            os.environ["POSEIDON_STREAMING"] = prev
+
+
+def run_throughput(machines: int, seconds: float, seed: int) -> dict:
+    """Sustained-throughput rung (``--child throughput``): fixed-duration
+    continuous churn through the FULL stack, streaming engine vs the
+    round-synchronous loop on the same machine/workload generator —
+    placements/sec, realized round-overlap fraction, and admission
+    staleness p50/p99 — plus a fixed-round byte-identity leg (per-round
+    drained, so both modes must produce identical placement digests).
+
+    The result carries ``mode: "streaming"``; tools/bench_compare.py
+    refuses to diff its series against a synchronous-mode artifact."""
+    identity_sync = _throughput_session(
+        machines, seed, streaming=False, fixed_rounds=6
+    )
+    identity_stream = _throughput_session(
+        machines, seed, streaming=True, fixed_rounds=6
+    )
+    sync = _throughput_session(
+        machines, seed, streaming=False, seconds=seconds
+    )
+    stream = _throughput_session(
+        machines, seed, streaming=True, seconds=seconds
+    )
+    identity_ok = bool(
+        identity_sync.get("ok") and identity_stream.get("ok")
+        and identity_sync.get("digests") == identity_stream.get("digests")
+    )
+    out = {
+        "ok": bool(sync.get("ok") and stream.get("ok") and identity_ok),
+        "mode": "streaming",
+        "machines": machines,
+        "seconds": seconds,
+        "identity_ok": identity_ok,
+        "identity_rounds": len(identity_sync.get("digests") or []),
+        "streaming": stream,
+        "synchronous": sync,
+        "placements_per_sec": stream.get("placements_per_sec", 0.0),
+        "placements_per_sec_sync": sync.get("placements_per_sec", 0.0),
+    }
+    base = out["placements_per_sec_sync"]
+    out["throughput_gain"] = (
+        round(out["placements_per_sec"] / base, 3) if base else 0.0
+    )
+    if not identity_ok:
+        out["error"] = (
+            "streaming/synchronous placement digests diverged: "
+            f"{identity_sync.get('digests')} vs "
+            f"{identity_stream.get('digests')}"
+        )
+    return out
+
+
 def run_parity() -> dict:
     """BASELINE config 1 (100 nodes / 1k pods): TPU solver objective must
     equal the exact host oracle on the same transportation instance."""
@@ -1111,7 +1353,7 @@ def run_cluster_rung(machines: int, tasks: int, ecs: int, rounds: int,
 
 
 def build_artifact(rungs, target, parity, trace, features,
-                   cluster=None) -> dict:
+                   cluster=None, throughput=None) -> dict:
     """The scored JSON line the driver records.
 
     Scores ONLY the target config (the north star, or the requested
@@ -1150,6 +1392,14 @@ def build_artifact(rungs, target, parity, trace, features,
         # per-device work series.  Not the scored number — the north
         # star stays the target config above.
         out["cluster"] = cluster
+    if throughput is not None:
+        # The sustained-throughput rung (streaming round engine).  Its
+        # ``mode`` marker rides to the top so tools/bench_compare.py can
+        # refuse to diff streaming series against a synchronous-mode
+        # baseline artifact.
+        out["throughput"] = throughput
+        if throughput.get("mode"):
+            out["mode"] = throughput["mode"]
     if best is None:
         out.update({"value": None, "vs_baseline": 0.0,
                     "error": f"target rung {target[0]}/{target[1]} "
@@ -1300,8 +1550,11 @@ def main(argv=None) -> int:
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--child",
                    choices=["rung", "parity", "trace", "features", "soak",
-                            "cluster"],
+                            "cluster", "throughput"],
                    default=None)
+    p.add_argument("--seconds", type=float, default=6.0,
+                   help="fixed duration for --child throughput's "
+                        "continuous-churn legs")
     p.add_argument("--cluster", action="store_true",
                    help="also run the opt-in cluster-scale rung "
                         "(CLUSTER_RUNG; sharded band tier)")
@@ -1350,6 +1603,11 @@ def main(argv=None) -> int:
             args.machines or 200, max(args.rounds, 8), args.plan, args.seed
         )))
         return 0
+    if args.child == "throughput":
+        print(json.dumps(run_throughput(
+            args.machines or 64, args.seconds, args.seed
+        )))
+        return 0
     if args.child == "cluster":
         print(json.dumps(run_cluster_rung(
             args.machines or CLUSTER_RUNG[0],
@@ -1375,12 +1633,13 @@ def main(argv=None) -> int:
     trace = {"ok": False, "error": "not run"}
     features = {"ok": False, "error": "not run"}
     cluster = None
+    throughput = None
 
     live_evidence = _load_last_live_tpu(target)  # once; None when absent
 
     def emit():
         art = build_artifact(rungs, target, parity, trace, features,
-                             cluster=cluster)
+                             cluster=cluster, throughput=throughput)
         if art.get("backend") != "tpu" and live_evidence is not None:
             art["last_live_tpu"] = live_evidence
         print(json.dumps(art), flush=True)
@@ -1442,6 +1701,15 @@ def main(argv=None) -> int:
         features = _stage("features", [
             "--machines", "10000", "--rounds", "3",
         ], features_timeout_s())
+        emit()
+        # Sustained-throughput rung: streaming vs synchronous through
+        # the full glue+service stack at modest scale (the number is a
+        # RATIO claim — overlap gain — not a scale claim, so it never
+        # pays ladder-sized machine counts).
+        throughput = _stage("throughput", [
+            "--machines", "64", "--seconds", "6",
+            "--seed", str(args.seed),
+        ], rung_timeout_s())
         emit()
     for machines, tasks in ladder[1:]:
         run_rung_child(machines, tasks)

@@ -386,13 +386,28 @@ def run_soak(
             transfers0 = implicit_transfer_count()
             edges0 = lock_order_edge_count()
             contention0 = lock_contention_ns()
-            for _attempt in range(cfg.crash_loop_budget + 1):
+            for _attempt in range(2 * (cfg.crash_loop_budget + 1)):
                 delay = poseidon.try_round()
                 if delay is None:
                     raise SoakFailure(
                         "fatal", poseidon.fatal or "loop stopped", r
                     )
-                if poseidon.loop_stats.consecutive_failures == 0:
+                # Streaming (POSEIDON_STREAMING=1): the round returns
+                # with its enactment still in flight on the worker —
+                # join it before the ledger diff and the divergence
+                # gate read anything (a no-op in synchronous mode).  A
+                # failure parked on the worker surfaces at the NEXT
+                # try_round's join, so loop until a round both
+                # schedules AND enacts cleanly; each parked failure
+                # burns one extra attempt, hence the doubled bound
+                # (sync mode still exhausts the budget via delay=None
+                # exactly as before).
+                if not poseidon.drain_rounds(timeout=60.0):
+                    raise SoakFailure(
+                        "drain", "streaming enactment never drained", r
+                    )
+                if (poseidon.loop_stats.consecutive_failures == 0
+                        and not poseidon.enact_failed()):
                     break
                 # Failed round: the soak compresses the backoff delay
                 # (the policy fired; sleeping it for real buys nothing).

@@ -39,11 +39,12 @@ full rebuild for that round.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional
 
 import numpy as np
 
 from poseidon_tpu.utils.hatches import hatch_bool, hatch_int
+from poseidon_tpu.utils.locks import TrackedLock
 from poseidon_tpu.costmodel.base import (
     CostMatrices,
     CostModel,
@@ -119,6 +120,23 @@ class CostPlaneCache:
         # RoundMetrics): delta_hit is True when the incremental path
         # served, rows/cols_rebuilt count the dirty slices it rebuilt.
         self.last_stats: dict = self._stats(False, 0, 0, "disabled")
+        # Continuous-ingest seam (the streaming round engine): dirty
+        # hints — EC ids / machine uuids touched by watcher deltas —
+        # pushed as events arrive instead of discovered at the build's
+        # snapshot diff.  Hints are CONSERVATIVE: the round's builds
+        # union them into the diffed dirty sets (forcing at most an
+        # extra rebuilt slice, never a stale one — cell purity makes
+        # the rebuild bit-identical either way), so a hint can never be
+        # wrong-result, only wasted.  Own TrackedLock: the pusher (the
+        # service's RPC threads, via ClusterState.take_ingest_hints →
+        # set_round_hints) and the builders (round thread + pipeline
+        # worker) are different threads.
+        self._ingest_lock = TrackedLock(
+            "costmodel.CostPlaneCache._ingest_lock"
+        )
+        self._hint_rows: set = set()   # dirty EC ids
+        self._hint_cols: set = set()   # dirty machine uuids
+        self.ingest_hints_applied = 0  # rows+cols forced dirty by hints
 
     @staticmethod
     def _stats(hit: bool, rows: int, cols: int, path: str) -> dict:
@@ -146,6 +164,56 @@ class CostPlaneCache:
             self._bands.pop(key, None)
             if key in self._ledgers:
                 self._ledgers[key].broken = True
+
+    def set_round_hints(self, ec_ids: Iterable[int],
+                        machine_uuids: Iterable[str]) -> None:
+        """Install this round's continuous-ingest dirty hints (replacing
+        the last round's): every build until the next call unions them
+        into its diffed dirty sets.  Thread-safe."""
+        with self._ingest_lock:
+            self._hint_rows = set(int(e) for e in ec_ids)
+            self._hint_cols = set(machine_uuids)
+
+    def ingest(self, ec_ids: Iterable[int] = (),
+               machine_uuids: Iterable[str] = ()) -> None:
+        """Accumulate dirty hints as events arrive (the watcher-thread
+        half of the seam; additive, unlike ``set_round_hints``)."""
+        with self._ingest_lock:
+            self._hint_rows.update(int(e) for e in ec_ids)
+            self._hint_cols.update(machine_uuids)
+
+    def _apply_hints(self, ecs: ECTable, machines: MachineTable,
+                     dirty_rows: np.ndarray,
+                     dirty_cols: np.ndarray):
+        """Union the installed ingest hints into one build's dirty sets
+        (hint identity -> positional index, unknown identities skipped:
+        a hint for a row/column not in this band costs nothing here)."""
+        with self._ingest_lock:
+            rows, cols = self._hint_rows, self._hint_cols
+            if not rows and not cols:
+                return dirty_rows, dirty_cols
+            add_r = [
+                i for i, e in enumerate(ecs.ec_ids.tolist())
+                if int(e) in rows
+            ]
+            add_c = [
+                j for j, u in enumerate(machines.uuids) if u in cols
+            ]
+        if add_r:
+            merged = np.union1d(dirty_rows,
+                                np.asarray(add_r, dtype=np.int64))
+            self.ingest_hints_applied += int(
+                merged.size - dirty_rows.size
+            )
+            dirty_rows = merged
+        if add_c:
+            merged = np.union1d(dirty_cols,
+                                np.asarray(add_c, dtype=np.int64))
+            self.ingest_hints_applied += int(
+                merged.size - dirty_cols.size
+            )
+            dirty_cols = merged
+        return dirty_rows, dirty_cols
 
     def take_ledger(self, key: int) -> Optional[PlaneLedger]:
         """Consume the band's accumulated dirty ledger (None = no build
@@ -198,6 +266,9 @@ class CostPlaneCache:
         dirty_cols = self._dirty_cols(prev, machines)
         if dirty_rows is None or dirty_cols is None:
             return self._full(key, ecs, machines, "full")
+        dirty_rows, dirty_cols = self._apply_hints(
+            ecs, machines, dirty_rows, dirty_cols
+        )
         work = dirty_rows.size * M + dirty_cols.size * E
         if work * GATE_DEN >= E * M * GATE_NUM:
             return self._full(key, ecs, machines, "gate")

@@ -13,6 +13,7 @@ Semantics match the reference's keyed queue (pkg/k8sclient/keyed_queue.go:24-135
 
 from __future__ import annotations
 
+import time
 from collections import OrderedDict
 from typing import Any, Hashable, List, Optional, Tuple
 
@@ -26,6 +27,12 @@ class KeyedQueue:
         self._parked: "OrderedDict[Hashable, List[Any]]" = OrderedDict()
         self._processing: set = set()
         self._shutdown = False
+        # First-enqueue timestamp per queued key (monotonic), kept in
+        # the same arrival order as _queue: the head entry is the
+        # oldest undelivered event, whose age is the glue-side ingest
+        # lag (oldest_age_s) the streaming engine's staleness bound is
+        # judged against.
+        self._enqueued_at: "OrderedDict[Hashable, float]" = OrderedDict()
 
     def add(self, key: Hashable, item: Any) -> None:
         with self._cond:
@@ -35,6 +42,7 @@ class KeyedQueue:
                 self._parked.setdefault(key, []).append(item)
             else:
                 self._queue.setdefault(key, []).append(item)
+                self._enqueued_at.setdefault(key, time.monotonic())
                 self._cond.notify()
 
     def get(self) -> Optional[Tuple[Hashable, List[Any]]]:
@@ -45,8 +53,18 @@ class KeyedQueue:
             if not self._queue:
                 return None
             key, items = self._queue.popitem(last=False)
+            self._enqueued_at.pop(key, None)
             self._processing.add(key)
             return key, items
+
+    def oldest_age_s(self) -> Optional[float]:
+        """Age of the oldest QUEUED (undelivered) batch, or None when
+        nothing waits.  A worker mid-batch does not count — delivery
+        latency, not processing latency, is the ingest-lag signal."""
+        with self._cond:
+            for ts in self._enqueued_at.values():
+                return time.monotonic() - ts
+            return None
 
     def done(self, key: Hashable) -> None:
         with self._cond:
@@ -54,6 +72,9 @@ class KeyedQueue:
             parked = self._parked.pop(key, None)
             if parked:
                 self._queue.setdefault(key, []).extend(parked)
+                # Unparked work re-enters the queue NOW; its wait while
+                # parked was serialization, not delivery lag.
+                self._enqueued_at.setdefault(key, time.monotonic())
                 self._cond.notify()
 
     def shut_down(self) -> None:

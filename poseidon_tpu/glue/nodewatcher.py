@@ -138,6 +138,9 @@ class NodeWatcher:
         return watch
 
     def _worker(self) -> None:
+        # Continuous ingest (see PodWatcher._worker): node deltas land
+        # in ClusterState as they arrive; watch_event stamps ingest
+        # liveness for /healthz's streaming wedge gate.
         while True:
             batch = self.queue.get()
             if batch is None:

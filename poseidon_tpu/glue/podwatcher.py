@@ -221,6 +221,13 @@ class PodWatcher:
     # ----------------------------------------------------------- phase machine
 
     def _worker(self) -> None:
+        # The continuous-ingest thread of the streaming round engine:
+        # every event becomes RPC state in the service's ClusterState
+        # the moment it is processed (the state's own lock publishes
+        # it), and watch_event's stamp is the ingest-liveness signal
+        # /healthz judges wedged watchers by.  The round's admission
+        # cut happens service-side at view-snapshot time — nothing
+        # here batches or waits on round boundaries.
         while True:
             batch = self.queue.get()
             if batch is None:

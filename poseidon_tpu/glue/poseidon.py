@@ -18,6 +18,7 @@ import logging
 import random
 import threading
 import time
+from concurrent import futures as cf
 from dataclasses import dataclass
 from typing import List, Optional
 
@@ -33,6 +34,8 @@ from poseidon_tpu.obs import trace as obs_trace
 from poseidon_tpu.protos import firmament_pb2 as fpb
 from poseidon_tpu.service.client import FirmamentClient, rpc_code
 from poseidon_tpu.utils.config import PoseidonConfig
+from poseidon_tpu.utils.hatches import hatch_bool, hatch_float
+from poseidon_tpu.utils.locks import TrackedLock
 
 log = logging.getLogger("poseidon")
 
@@ -115,9 +118,31 @@ class Poseidon:
         # which the service may hold placements whose deltas were lost.
         self._enacted: dict = {}
         self._schedule_suspect = False
+        # Suspicion generation: bumped on every _mark_suspect.  The
+        # streaming enact worker clears the flag only if the generation
+        # it captured at submit is still current — new suspicion raised
+        # concurrently (a schedule RPC failing mid-enact) survives.
+        self._suspect_gen = 0
         # Half-completed rollbacks: uid -> (td, jd) whose task_removed
         # landed but whose resubmit RPC failed (replayed every round).
         self._resubmit_pending: dict = {}
+        # Guards the glue state that BOTH the round thread and the
+        # streaming enact worker mutate: the resubmit-pending map and
+        # the suspect flag/generation.  Held only around dict/flag
+        # writes, never across an RPC.  Synchronous mode takes it
+        # uncontended on the one round thread.
+        self._state_lock = TrackedLock("glue.Poseidon._state_lock")
+        # Streaming round engine (POSEIDON_STREAMING): the single-worker
+        # enactment executor and the in-flight round's future.  With the
+        # hatch off neither is ever created and schedule_once runs the
+        # round-synchronous path bit-identically.
+        self._enact_pool: Optional[cf.ThreadPoolExecutor] = None
+        self._enact_future: Optional[cf.Future] = None
+        # Sustained-throughput gauge state: placements/sec over the
+        # window since the previous metrics observation.
+        self.placements_per_sec = 0.0
+        self._pps_t: Optional[float] = None
+        self._pps_placed = 0
         # Last successful round's deltas (the flight recorder's view).
         self.last_deltas: List[fpb.SchedulingDelta] = []
 
@@ -150,14 +175,23 @@ class Poseidon:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=5.0)
+        # Quiesce the streaming engine AFTER the loop thread (it is the
+        # only submitter): join the in-flight enactment so no worker
+        # races the watcher/server teardown below.
+        try:
+            self._join_enact()
+        except Exception:  # noqa: BLE001 - shutdown path
+            log.exception("in-flight enactment failed during stop")
+        if self._enact_pool is not None:
+            self._enact_pool.shutdown(wait=True)
         self.pod_watcher.stop()
         self.node_watcher.stop()
         if self.stats_server is not None:
             self.stats_server.stop()
         if self.metrics_server is not None:
             self.metrics_server.stop()
-        if self._loop_thread is not None:
-            self._loop_thread.join(timeout=5.0)
 
     def __enter__(self) -> "Poseidon":
         return self.start()
@@ -216,12 +250,38 @@ class Poseidon:
             return backoff * (0.5 + 0.5 * self._backoff_jitter.random())
         self.loop_stats.consecutive_failures = 0
         self._observe_metrics()
-        return self.config.scheduling_interval
+        delay = self.config.scheduling_interval
+        if hatch_bool("POSEIDON_STREAMING"):
+            # The bounded-staleness deadline IS the streaming cadence:
+            # cut the next round's admission no later than the staleness
+            # bound, even when the configured interval is longer.
+            delay = min(
+                delay, hatch_float("POSEIDON_ADMISSION_STALENESS_S")
+            )
+        return delay
 
     def _observe_metrics(self) -> None:
         """Refresh the Prometheus registry from the loop's state (every
         round outcome, success or failure — the exporter thread only
         reads)."""
+        # Sustained throughput over the window since the last
+        # observation.  In streaming mode placed is bumped by the enact
+        # worker concurrently — a torn read here skews one gauge sample,
+        # never the stats themselves.
+        now = time.monotonic()
+        placed = self.loop_stats.placed
+        if self._pps_t is not None and now > self._pps_t:
+            self.placements_per_sec = (
+                (placed - self._pps_placed) / (now - self._pps_t)
+            )
+        self._pps_t = now
+        self._pps_placed = placed
+        ages = [
+            a for a in (
+                self.pod_watcher.queue.oldest_age_s(),
+                self.node_watcher.queue.oldest_age_s(),
+            ) if a is not None
+        ]
         obs_metrics.observe_loop(
             self.loop_stats,
             resyncs=(
@@ -229,6 +289,8 @@ class Poseidon:
             ),
             crash_loop_budget=self.config.crash_loop_budget,
             fatal=self.fatal is not None,
+            placements_per_sec=self.placements_per_sec,
+            ingest_lag_s=max(ages) if ages else 0.0,
         )
         obs_metrics.observe_ledger()
 
@@ -242,7 +304,17 @@ class Poseidon:
         reservation) instead of leaving the scheduler's view diverged
         from the kube truth, and the remaining deltas still enact.
         Unknown ids stay fatal (poseidon.go:43) — they mean the id maps
-        themselves are broken, which no retry fixes."""
+        themselves are broken, which no retry fixes.
+
+        POSEIDON_STREAMING=1 switches to the streaming round engine:
+        this round's Schedule() RPC overlaps the PREVIOUS round's
+        enactment (running on a single-worker executor), and the new
+        round's enactment is handed to that worker in turn.  With the
+        hatch off (default) the synchronous path below runs — schedule,
+        enact, reconcile, GC, in program order on the round thread,
+        bit-identical to the pre-streaming loop."""
+        if hatch_bool("POSEIDON_STREAMING"):
+            return self._schedule_once_streaming()
         # Round-thread confinement: only the thread driving try_round
         # (the loop thread, or the soak's main thread with
         # run_loop=False) writes last_deltas/_enacted; readers consume
@@ -264,7 +336,7 @@ class Poseidon:
             # reply.  Mark the window; the next fully-enacted round
             # reconciles (see below).
             if rpc_code(e) != grpc.StatusCode.UNAVAILABLE:
-                self._schedule_suspect = True
+                self._mark_suspect()
             raise
         # Recorded before enactment so a round that fails mid-enactment
         # still attributes THESE deltas (not a previous round's) to
@@ -277,8 +349,69 @@ class Poseidon:
             # retry's reply the diff against an already-committed round
             # — so a retried schedule is commit-ambiguous too.  The
             # sweep is cheap next to a permanent phantom divergence.
-            self._schedule_suspect = True
+            self._mark_suspect()
         suspect = self._schedule_suspect
+        gen = self._suspect_gen
+        self._enact_phase(deltas, suspect, gen)
+        return list(deltas)
+
+    def _schedule_once_streaming(self) -> List[fpb.SchedulingDelta]:
+        """The streaming round: overlap this round's Schedule() RPC with
+        the previous round's enactment, then hand this round's deltas to
+        the enact worker.
+
+        Round order: (1) flush parked resubmits (lock-disciplined — the
+        worker may be adding to the map concurrently); (2) Schedule()
+        RPC, overlapping enact(N-1) on the worker; (3) JOIN enact(N-1) —
+        its failure is surfaced as THIS round's failure, and this
+        round's already-committed deltas are dropped un-enacted, so the
+        suspect reconciler is armed exactly as for a lost reply;
+        (4) submit enact(N) to the worker with the suspect snapshot.
+        The worker clears suspicion only if no NEW suspicion arrived
+        while it ran (the generation check in _enact_phase)."""
+        self.last_deltas = []  # handoff: round-thread-confined — the
+        # enact worker receives its deltas by argument, never through
+        # this attribute; readers (spans, soak, tests) run on or after
+        # the round thread (same discipline as the synchronous path).
+        with obs_trace.span("glue.flush_resubmits"):
+            self._flush_resubmits()
+        try:
+            with obs_trace.span("glue.schedule_rpc"):
+                deltas = self.fc.schedule()
+        except Exception as e:
+            if rpc_code(e) != grpc.StatusCode.UNAVAILABLE:
+                self._mark_suspect()
+            # The in-flight enactment keeps running through the failure
+            # backoff; the NEXT round (or drain/stop) joins it.
+            raise
+        self.last_deltas = list(deltas)  # handoff: round-thread-confined
+        if getattr(self.fc, "schedule_retried", False):
+            self._mark_suspect()
+        try:
+            self._join_enact()
+        except Exception:
+            # enact(N-1) failed AND this round's committed deltas are
+            # now dropped un-enacted — both are phantom-placement
+            # shapes; arm the reconciler before surfacing.
+            self._mark_suspect()
+            raise
+        suspect = self._schedule_suspect
+        gen = self._suspect_gen
+        if self._enact_pool is None:
+            self._enact_pool = cf.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="enact-worker"
+            )
+        self._enact_future = self._enact_pool.submit(
+            self._enact_phase, deltas, suspect, gen
+        )
+        return list(deltas)
+
+    def _enact_phase(self, deltas, suspect: bool, gen: int) -> None:
+        """The round's enactment tail: enact, reconcile if the round
+        opened suspect, GC the enacted map, conditionally clear
+        suspicion, count the round.  Runs on the round thread
+        synchronously; on the single enact worker under streaming (at
+        most one in flight — the next round joins before submitting)."""
         delta_uids = set()
         try:
             with obs_trace.span("glue.enact", deltas=len(deltas)):
@@ -288,7 +421,7 @@ class Poseidon:
             # committed deltas — the same phantom shape as a lost
             # reply.  Arm the reconciler; the next fully-enacted round
             # requeues whatever never got bound.
-            self._schedule_suspect = True
+            self._mark_suspect()
             raise
         if suspect:
             with obs_trace.span("glue.reconcile"):
@@ -297,15 +430,58 @@ class Poseidon:
         # cluster (the pod watcher owns those transitions) must leave
         # the enacted map, or it grows one entry per pod ever placed.
         live = self.shared.live_uids()
-        self._enacted = {  # handoff: round-thread-confined (see above)
+        self._enacted = {  # handoff: enact-phase-confined
             uid: node for uid, node in self._enacted.items() if uid in live
         }
-        # Cleared only here, after enactment AND reconcile completed: a
-        # round that raises mid-way keeps the flag, so the pending
-        # reconcile is retried instead of silently dropped.
-        self._schedule_suspect = False
+        # Cleared only here, after enactment AND reconcile completed —
+        # and only if no NEW suspicion arrived while this phase ran (a
+        # concurrent Schedule() failure under streaming): a round that
+        # raises mid-way keeps the flag, so the pending reconcile is
+        # retried instead of silently dropped.
+        with self._state_lock:
+            if self._suspect_gen == gen:
+                self._schedule_suspect = False
         self.loop_stats.rounds += 1
-        return list(deltas)
+
+    def _mark_suspect(self) -> None:
+        """Open (or re-open) the commit-ambiguity window; the bumped
+        generation keeps a concurrent enact phase from clearing it."""
+        with self._state_lock:
+            self._schedule_suspect = True
+            self._suspect_gen += 1
+
+    def _join_enact(self) -> None:
+        """Consume the in-flight enactment's outcome (streaming); no-op
+        when nothing is in flight (synchronous mode always)."""
+        fut = self._enact_future
+        if fut is None:
+            return
+        self._enact_future = None
+        with obs_trace.span("glue.enact_join"):
+            fut.result()
+
+    def drain_rounds(self, timeout: float = 30.0) -> bool:
+        """Wait for the in-flight enactment WITHOUT consuming its
+        outcome — the next round's join still surfaces a failure to the
+        loop's failure policy.  The soak harness calls this after every
+        try_round so its per-round kube-truth gates see a quiesced
+        engine; a no-op in synchronous mode."""
+        fut = self._enact_future
+        if fut is None:
+            return True
+        done, _ = cf.wait([fut], timeout=timeout)
+        return bool(done)
+
+    def enact_failed(self) -> bool:
+        """True when a drained-but-unconsumed streaming enactment
+        failed; that failure surfaces at the next round's join.  Lets
+        round-by-round drivers (the soak) keep retrying until a round
+        both scheduled AND enacted cleanly."""
+        fut = self._enact_future
+        return bool(
+            fut is not None and fut.done()
+            and fut.exception() is not None
+        )
 
     def _enact(self, deltas, delta_uids: set) -> None:
         """Apply one round's deltas to the cluster (transactional per
@@ -385,8 +561,10 @@ class Poseidon:
             # pend forever — park the descriptor; _flush_resubmits
             # replays it at the top of every round until it lands.  The
             # raise fails this round, so the crash-loop budget governs
-            # the retry cadence.
-            self._resubmit_pending[uid] = (td, jd)
+            # the retry cadence.  Lock: under streaming this runs on the
+            # enact worker while the round thread may be flushing.
+            with self._state_lock:
+                self._resubmit_pending[uid] = (td, jd)
             raise
         self.loop_stats.requeued += 1
 
@@ -395,13 +573,19 @@ class Poseidon:
         replay parked resubmits until each lands or its pod left the
         cluster.  TASK_SUBMITTED_OK / ALREADY_SUBMITTED are both
         tolerated replies, so a replay that raced a watcher resubmit is
-        harmless."""
-        for uid, (td, jd) in sorted(self._resubmit_pending.items()):
+        harmless.  The map is snapshotted and pruned under the state
+        lock (the streaming enact worker parks entries concurrently);
+        the RPCs themselves run outside it."""
+        with self._state_lock:
+            pending = sorted(self._resubmit_pending.items())
+        for uid, (td, jd) in pending:
             if self.shared.get_task(uid) is None:
-                del self._resubmit_pending[uid]  # pod left the cluster
-                continue
+                with self._state_lock:
+                    self._resubmit_pending.pop(uid, None)
+                continue  # pod left the cluster
             self.fc.task_submitted(td, jd)
-            del self._resubmit_pending[uid]
+            with self._state_lock:
+                self._resubmit_pending.pop(uid, None)
             self.loop_stats.requeued += 1
 
     def _reconcile_after_failure(self, delta_uids) -> None:

@@ -178,6 +178,20 @@ class RoundMetrics:
     # cold retry (gap_bound is then inf and the committed placement is the
     # repaired feasible-but-suboptimal one).  Alarmed via log.error.
     converged: bool = True
+    # Streaming round engine (POSEIDON_STREAMING).  overlap_fraction:
+    # share of this round's wall time that ran concurrently with the
+    # previous round's tail (cross-round speculative cost build plus the
+    # glue side's enact/schedule overlap); 0.0 in the synchronous loop.
+    # admission_deferred: watcher deltas that arrived after this round's
+    # admission cut and rolled to round N+1.  admission_staleness_s: age
+    # of the OLDEST delta admitted into this round at the cut (the
+    # bounded-staleness bound actually realized).  placements_per_sec is
+    # stamped by the glue loop (placed / round wall) — the service-side
+    # solve path leaves it 0.
+    overlap_fraction: float = 0.0
+    admission_deferred: int = 0
+    admission_staleness_s: float = 0.0
+    placements_per_sec: float = 0.0
 
     # Serialization schema version: bumped whenever a field is renamed
     # or its meaning changes (pure additions keep the version — from_dict
@@ -451,6 +465,14 @@ class RoundPlanner:
         # Cross-band pipeline (graph/pipeline.py): speculative next-band
         # cost builds on a single worker, overlapped with band solves.
         self._cost_pipeline = None
+        # Submission time of the cross-ROUND speculation (streaming round
+        # engine): set when this round, on its way out, speculates the
+        # next round's first cost build on frozen final usage.  None when
+        # no cross-round spec was submitted this round.  The next round
+        # harvests the spec's realized run time into _cross_overlap_prev
+        # at its admission cut.
+        self._cross_spec_t = None
+        self._cross_overlap_prev = 0.0
         # Last build's delta stats for the band currently being solved
         # (consumed by the reduced-plane certificate cache).
         self._last_build_stats: dict = self._plane_cache.last_stats
@@ -874,6 +896,29 @@ class RoundPlanner:
             view = st.build_round_view(
                 include_running=self.reschedule_running
             )
+        # Admission cut (the streaming bounded-staleness batcher): the
+        # view snapshot IS the round's input set — everything that
+        # arrived before it is admitted, later arrivals roll to round
+        # N+1 (counted as admission_deferred at round end).  The dirty
+        # hints ride to the plane cache's continuous-ingest seam only
+        # under streaming; the synchronous loop discards them so its
+        # delta-rebuild accounting stays exactly as before.
+        streaming = hatch_bool("POSEIDON_STREAMING")
+        _admitted, adm_stale = st.admission_cut()
+        ing_rows, ing_cols = st.take_ingest_hints()
+        if streaming:
+            self._plane_cache.set_round_hints(ing_rows, ing_cols)
+        # Harvest the PREVIOUS round's cross-round speculation: every
+        # second its build ran after submission — the previous round's
+        # own tail, the glue side's enactment, RPC transit — is work
+        # THIS round would otherwise pay inside its own wall time, so
+        # it is credited here as realized cross-round overlap.
+        self._cross_overlap_prev = 0.0
+        if (streaming and self._cross_spec_t is not None
+                and self._cost_pipeline is not None):
+            self._cross_overlap_prev = self._cost_pipeline.overlap_with(
+                self._cross_spec_t, time.perf_counter()
+            )
         ecs, mt = view.ecs, view.machines
         if not self.pod_affinity:
             # Feature gate: drop the pod-(anti-)affinity vocabulary before
@@ -885,6 +930,7 @@ class RoundPlanner:
             num_tasks=int(ecs.supply.sum()),
             num_machines=mt.num_machines,
         )
+        metrics.admission_staleness_s = round(adm_stale, 6)
         if ecs.num_ecs == 0:
             st.round_index += 1
             self._last_generation = st.generation
@@ -1033,7 +1079,22 @@ class RoundPlanner:
         # moves the starvation escalator next round, so the quiet-round
         # fast path must not trigger.
         self._last_unscheduled = metrics.unscheduled + metrics.preempted
+        # Arrivals that landed after this round's admission cut: they
+        # are round N+1's input set (the bounded-staleness batcher's
+        # deferred side).
+        metrics.admission_deferred = st.pending_ingest()
         metrics.total_seconds = time.perf_counter() - t0
+        # Realized round overlap: the cross-band pipeline's in-solve
+        # concurrency plus the previous round's cross-round speculation
+        # harvested at this round's start (work that ran during the
+        # inter-round enactment window instead of inside this round's
+        # wall time).  A fraction of the round's wall — 0.0 in the
+        # fully synchronous configuration.
+        overlap = self._pipeline_overlap + self._cross_overlap_prev
+        if metrics.total_seconds > 0 and overlap > 0:
+            metrics.overlap_fraction = round(
+                min(1.0, overlap / metrics.total_seconds), 6
+            )
         self.last_metrics = metrics
         return deltas, metrics
 
@@ -1274,6 +1335,7 @@ class RoundPlanner:
         self._cost_rows_rebuilt = 0
         self._cost_cols_rebuilt = 0
         self._pipeline_overlap = 0.0
+        self._cross_spec_t = None
         self._tier_rank = -1
         self._sharded_bands = 0
         self._shard_devices = 0
@@ -1290,6 +1352,7 @@ class RoundPlanner:
             if chained is not None:
                 return chained
         pipe = self._maybe_pipeline(len(remaining))
+        first_band, first_idx = None, None
         while remaining:
             n_bands, idx = self._next_band_group(
                 remaining, bands, ecs, mt, committed_cpu, committed_ram,
@@ -1297,6 +1360,8 @@ class RoundPlanner:
             )
             band = int(remaining[0])  # warm-frame key: group's largest
             remaining = remaining[n_bands:]
+            if first_band is None:
+                first_band, first_idx = band, idx
             ecs_b = _slice_ecs(ecs, idx)
             mt_b = _with_usage(
                 mt, committed_cpu, committed_ram, committed_net,
@@ -1383,6 +1448,37 @@ class RoundPlanner:
                 # bands write DISJOINT rows of flows_full, so a worker
                 # reading this band's rows races nothing.
                 on_band(idx, not remaining, flows_full)
+
+        # No small-band floor here (unlike the cross-band speculation
+        # above): the cross-round spec runs while the worker is
+        # otherwise IDLE — the glue side is enacting — so even a build
+        # the delta cache declines (a full small rebuild) is pure
+        # overlap, not contention.
+        if (pipe is not None and first_idx is not None
+                and hatch_bool("POSEIDON_STREAMING")):
+            # Cross-ROUND speculation (streaming round engine): while the
+            # glue side enacts this round's deltas, the pipeline worker
+            # pre-builds next round's first band against the FINAL
+            # committed usage.  Next round's authoritative pipe.build
+            # joins it and delta-patches whatever the admitted watcher
+            # deltas actually dirtied — exactly the cross-band contract,
+            # so a wrong speculation is never a wrong result.  The band
+            # key is this round's first band: churn between rounds is
+            # incremental, so the largest band usually recurs; when it
+            # does not, the speculative snapshot simply goes unused.
+            pipe.speculate(
+                first_band,
+                _slice_ecs(ecs, first_idx),
+                _with_usage(
+                    mt, committed_cpu.copy(), committed_ram.copy(),
+                    committed_net.copy(),
+                    np.maximum(
+                        base_slots - committed_slots, 0
+                    ).astype(np.int32),
+                ),
+                parent_span_id=self._round_span_id(),
+            )
+            self._cross_spec_t = time.perf_counter()
 
         metrics.objective = objective
         metrics.gap_bound = gap
@@ -1479,14 +1575,18 @@ class RoundPlanner:
         """The cross-band pipeline, when it can pay: more than one band
         group to ladder through, the delta plane cache live (a
         speculative build must warm the cache, or joining it buys
-        nothing), and the env gate open."""
+        nothing), and the env gate open.  Under the streaming round
+        engine a SINGLE band still wants the pipeline — the speculation
+        runs across rounds (next round's first build overlaps this
+        round's enactment), not across bands."""
         from poseidon_tpu.graph.pipeline import (
             CostPipeline,
             pipelining_enabled,
         )
 
-        if (n_bands < 2 or not pipelining_enabled()
-                or not self._plane_cache.enabled()):
+        if n_bands < 2 and not hatch_bool("POSEIDON_STREAMING"):
+            return None
+        if not pipelining_enabled() or not self._plane_cache.enabled():
             return None
         if self._cost_pipeline is None:
             self._cost_pipeline = CostPipeline(self._plane_cache)

@@ -32,6 +32,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from poseidon_tpu.obs import trace as _trace
 from poseidon_tpu.obs.history import RoundHistory, default_history
+from poseidon_tpu.utils.hatches import hatch_bool, hatch_float
 from poseidon_tpu.utils.locks import TrackedLock
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -360,6 +361,11 @@ def _fresh_health() -> dict:
         "consecutive_failures": 0,
         "crash_loop_budget": 0,
         "resyncs": 0,
+        # monotime() of the last watcher event processed (watch_event):
+        # the streaming engine's ingest-liveness signal.  None until the
+        # first event — a process whose watchers simply have nothing to
+        # say is healthy, not wedged.
+        "last_ingest_ts": None,
     }
 
 
@@ -388,7 +394,23 @@ def health_report(history: Optional[RoundHistory] = None) -> dict:
     h["last_round_age_s"] = (
         round(now - ts, 3) if ts is not None else None
     )
+    ing = h.pop("last_ingest_ts")
+    h["last_ingest_age_s"] = (
+        round(now - ing, 3) if ing is not None else None
+    )
     h["ok"] = not h["loop_fatal"]
+    # Wedged-ingest gate (streaming only): a dead watcher thread is
+    # invisible to round liveness — speculative rounds keep completing
+    # against a frozen view — so /healthz fails once the last processed
+    # watch event is older than POSEIDON_INGEST_STALL_S.  Armed only
+    # after a FIRST event (quiet clusters are healthy) and only with a
+    # positive stall bound (0 disables).
+    if h["ok"] and hatch_bool("POSEIDON_STREAMING"):
+        stall = hatch_float("POSEIDON_INGEST_STALL_S")
+        if (stall > 0 and h["last_ingest_age_s"] is not None
+                and h["last_ingest_age_s"] > stall):
+            h["ok"] = False
+            h["ingest_stalled"] = True
     return h
 
 
@@ -582,7 +604,8 @@ def observe_round(metrics, registry: Optional[Registry] = None) -> None:
 
 
 def observe_loop(stats, *, resyncs: int = 0, crash_loop_budget: int = 0,
-                 fatal: bool = False,
+                 fatal: bool = False, placements_per_sec: float = 0.0,
+                 ingest_lag_s: float = 0.0,
                  registry: Optional[Registry] = None) -> None:
     """Feed the glue loop's ``LoopStats`` + watcher resync counts.
     Cumulative LoopStats fields pin counters via ``set_total`` (the
@@ -621,6 +644,16 @@ def observe_loop(stats, *, resyncs: int = 0, crash_loop_budget: int = 0,
         "poseidon_loop_fatal",
         "1 once the crash-loop budget stopped the schedule loop",
     ).set(1.0 if fatal else 0.0)
+    reg.gauge(
+        "poseidon_loop_placements_per_sec",
+        "Sustained placement throughput over the last observation "
+        "window (the streaming rung's headline series)",
+    ).set(float(placements_per_sec))
+    reg.gauge(
+        "poseidon_ingest_queue_age_s",
+        "Age of the oldest undelivered watcher event (glue-side ingest "
+        "lag; 0 when both watch queues are drained)",
+    ).set(float(ingest_lag_s))
 
 
 def observe_locks(registry: Optional[Registry] = None) -> None:
@@ -713,3 +746,8 @@ def watch_event(watcher: str, kind: str,
         "Watch events processed by the pod/node watchers",
         ("watcher", "kind"),
     ).inc(1.0, watcher, kind)
+    # Ingest-liveness stamp for /healthz: every processed watcher event
+    # proves the ingest path is moving (see health_report's wedged-
+    # ingest gate for the streaming engine).
+    with _HEALTH_LOCK:
+        _HEALTH["last_ingest_ts"] = _trace.monotime()

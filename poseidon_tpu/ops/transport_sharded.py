@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from poseidon_tpu.ops import transport
+from poseidon_tpu.utils.hatches import hatch_bool
 from poseidon_tpu.ops.transport import (
     INF_COST,
     TransportSolution,
@@ -93,8 +94,23 @@ def solve_transport_sharded(
     inadmissible columns (dead columns never carry flow, so padding is
     semantically invisible); every ``[*, M]`` operand is device_put with its
     machine axis laid over ``mesh`` and the shared jitted kernel runs SPMD
-    across the mesh's devices.  Solutions are bit-identical to the
-    single-chip path (same kernel, same arithmetic).
+    across the mesh's devices.
+
+    Column-to-shard assignment is STRIDED by default
+    (``POSEIDON_SHARD_STRIDED``): device ``d`` holds original columns
+    ``d, d+n_dev, d+2*n_dev, ...`` — contended columns (which cluster by
+    construction: the cost model emits machines in rack/capacity order)
+    spread round-robin over the mesh instead of concentrating on one
+    device (docs/PERF.md round 10 measured ~6x lane imbalance under
+    contiguous blocks).  The permutation is applied host-side after the
+    warm/greedy start and undone on the fetched results, so callers see
+    original column order and warm frames stay valid; shapes are
+    unchanged, so compile keys are unchanged.  With
+    ``POSEIDON_SHARD_STRIDED=0`` (contiguous blocks) solutions are
+    bit-identical to the single-chip path (same kernel, same
+    arithmetic, same memory order); the strided layout preserves the
+    objective and the certificate but may break cost ties in a
+    different order than the single-chip solve.
     """
     costs = np.asarray(costs, dtype=np.int32)
     supply = np.asarray(supply, dtype=np.int32)
@@ -166,6 +182,24 @@ def solve_transport_sharded(
         max_cost_hint,
     )
 
+    # Strided column-to-shard layout: slot d*B+k of the padded machine
+    # axis holds original column k*n_dev+d, so the contiguous block
+    # NamedSharding hands device d every (c % n_dev == d) column.
+    # Applied AFTER the greedy start and _host_validate (both run in
+    # original column order — scale/eps and the warm duals are layout-
+    # independent) and inverted on every fetched [*, m_pad] result
+    # below, so the caller-visible frame never changes.
+    strided = hatch_bool("POSEIDON_SHARD_STRIDED")
+    if strided:
+        blk = m_pad // n_dev
+        perm = np.arange(m_pad).reshape(blk, n_dev).T.ravel()
+        inv_perm = np.argsort(perm)
+        costs_p = np.ascontiguousarray(costs_p[:, perm])
+        capacity_p = np.ascontiguousarray(capacity_p[perm])
+        arc_cap_p = np.ascontiguousarray(arc_cap_p[:, perm])
+        flows_p = np.ascontiguousarray(flows_p[:, perm])
+        prices_p[e_pad : e_pad + m_pad] = prices_p[e_pad : e_pad + m_pad][perm]
+
     col = NamedSharding(mesh, P(None, MACHINE_AXIS))   # [E, M] matrices
     vec_m = NamedSharding(mesh, P(MACHINE_AXIS))       # [M] vectors
     repl = NamedSharding(mesh, P())                    # replicated
@@ -219,6 +253,12 @@ def solve_transport_sharded(
      phase_iters, telem) = host_fetch(
         flows, unsched, prices, iters, bf, clean, phase_iters, telem,
     )
+    if strided:
+        flows = flows[:, inv_perm]
+        prices_full = prices_full.copy()
+        prices_full[e_pad : e_pad + m_pad] = (
+            prices_full[e_pad : e_pad + m_pad][inv_perm]
+        )
     flows = flows[:E, :M]
     unsched = unsched[:E]
     prices_out = np.concatenate(

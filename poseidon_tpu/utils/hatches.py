@@ -138,6 +138,11 @@ HATCHES: Tuple[Hatch, ...] = (
           "Sharded-band gate: minimum contention in percent (supply "
           "as a share of open column capacity) before a band shards; "
           "an under-contended band drains faster on one chip"),
+    Hatch("POSEIDON_SHARD_STRIDED", "bool_on", "1",
+          "Strided (round-robin) column-to-shard assignment in the "
+          "sharded tier: spreads contended columns across the mesh "
+          "instead of contiguous blocks; 0 restores contiguous shards "
+          "(and bit-identical flows vs the single-chip path)"),
     # ----------------------------------------------------- incremental round
     Hatch("POSEIDON_COST_DELTA", "bool_on", "1",
           "Delta-maintained cost planes (costmodel/delta.py); 0 forces "
@@ -154,6 +159,20 @@ HATCHES: Tuple[Hatch, ...] = (
     Hatch("POSEIDON_MERGE_BANDS", "tristate", "",
           "Merge compatible bands into one device program "
           "(accelerator dispatch-count policy)"),
+    # ------------------------------------------------------- streaming rounds
+    Hatch("POSEIDON_STREAMING", "bool_off", "0",
+          "Streaming round engine (glue/poseidon.py): overlap round "
+          "N's enactment with round N+1's schedule RPC and speculate "
+          "the next round's cost build cross-round; 0 reproduces the "
+          "round-synchronous loop bit-identically"),
+    Hatch("POSEIDON_ADMISSION_STALENESS_S", "float", "0.25",
+          "Streaming admission batcher: bounded-staleness deadline in "
+          "seconds — deltas older than this at the round cut force the "
+          "cut, later arrivals roll to round N+1 (admission_deferred)"),
+    Hatch("POSEIDON_INGEST_STALL_S", "float", "60",
+          "Seconds without a watcher ingest event before /healthz "
+          "reports a wedged ingest path (503) while streaming rounds "
+          "still complete; 0 disables the stall gate"),
     # -------------------------------------------------------- observability
     Hatch("POSEIDON_TRACE", "bool_off", "0",
           "Record hierarchical spans (Perfetto-exportable; "

@@ -200,3 +200,55 @@ def test_sharded_mesh_rung_warm_budget0(monkeypatch):
     assert m.converged and m.gap_bound == 0.0
     assert m.fresh_compiles == 0
     assert m.implicit_transfers == 0
+
+
+def test_strided_shards_flatten_lopsided_lanes(monkeypatch):
+    """The PERF.md round-10 pathology, reproduced at smoke scale: when
+    machine capacity correlates with column index (fleets are commonly
+    listed in provisioning order, so contiguous uuid ranges share a
+    hardware generation), contiguous column blocks concentrate the big
+    contended machines in one shard and its lane does ~all the sweep
+    work.  Strided assignment (machine ``i`` -> shard ``i % n_dev``)
+    deals every capacity tier across all lanes.  Same solve either way
+    — the permutation is undone before results leave the kernel — so
+    objective and placement count must be bit-identical while
+    ``shard_imbalance`` drops."""
+    import numpy as np
+
+    import bench
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+    from poseidon_tpu.graph.state import ClusterState, MachineInfo
+
+    monkeypatch.setenv("POSEIDON_SHARDED_BANDS", "1")
+    monkeypatch.setenv("POSEIDON_SHARDED_MIN_COLS", "64")
+    monkeypatch.setenv("POSEIDON_SHARDED_MIN_CONTENTION", "1")
+
+    def solve(strided: bool):
+        monkeypatch.setenv(
+            "POSEIDON_SHARD_STRIDED", "1" if strided else "0"
+        )
+        state = ClusterState()
+        # Ascending capacity ramp: the contended tail of the column
+        # range lands entirely in the last contiguous shard.
+        for i in range(64):
+            state.node_added(MachineInfo(
+                uuid=f"mr-m{i:03d}", cpu_capacity=2000 + i * 450,
+                ram_capacity=1 << 24, task_slots=8,
+            ))
+        bench.submit_population(state, 600, 8, seed=0)
+        planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+        _, m = planner.schedule_round()
+        assert m.solve_tier == "sharded", m.solve_tier
+        return m
+
+    contig = solve(strided=False)
+    strided = solve(strided=True)
+    # Solution parity: striding is a layout choice, not a solver change.
+    assert strided.objective == contig.objective
+    assert strided.placed == contig.placed
+    # The point of the satellite: the lopsided lanes flatten.
+    assert strided.shard_imbalance < contig.shard_imbalance, (
+        f"strided {strided.shard_imbalance} !< "
+        f"contiguous {contig.shard_imbalance}"
+    )

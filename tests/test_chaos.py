@@ -694,3 +694,49 @@ def test_tiny_soak_all_families(tmp_path):
     assert out["warm_fresh_compiles"] == 0
     assert out["divergent_rounds"] == 0
     assert "host_greedy" in out["tiers"]
+
+
+def test_streaming_soak_matches_synchronous(tmp_path, monkeypatch):
+    """The streaming engine's acceptance gate under faults: the SAME
+    seeded fault plan, run once round-synchronously and once with the
+    overlapped loop, must leave byte-identical kube truth after every
+    round — cross-round speculation and deferred enactment are pure
+    overlap, never a semantic change.  (The soak harness drains the
+    in-flight enactment before each round's divergence check, so the
+    per-round digests compare like-for-like.)"""
+    monkeypatch.delenv("POSEIDON_STREAMING", raising=False)
+    sync = run_soak(
+        machines=12, rounds=6, plan="smoke", seed=0,
+        out_dir=str(tmp_path),
+    )
+    assert sync["ok"], sync.get("failure")
+
+    monkeypatch.setenv("POSEIDON_STREAMING", "1")
+    stream = run_soak(
+        machines=12, rounds=6, plan="smoke", seed=0,
+        out_dir=str(tmp_path),
+    )
+    assert stream["ok"], stream.get("failure")
+    assert stream["divergent_rounds"] == 0
+    assert stream["warm_fresh_compiles"] == 0
+    assert stream["digests"] == sync["digests"]
+
+
+def test_streaming_off_is_bit_identical_to_default(tmp_path, monkeypatch):
+    """POSEIDON_STREAMING=0 (the hatch's explicit off) must reproduce
+    the default synchronous round digests bit-for-bit — the hatch
+    registry's off-state really is today's loop, not a third mode."""
+    monkeypatch.delenv("POSEIDON_STREAMING", raising=False)
+    default = run_soak(
+        machines=12, rounds=4, plan="smoke", seed=3,
+        out_dir=str(tmp_path),
+    )
+    assert default["ok"], default.get("failure")
+
+    monkeypatch.setenv("POSEIDON_STREAMING", "0")
+    off = run_soak(
+        machines=12, rounds=4, plan="smoke", seed=3,
+        out_dir=str(tmp_path),
+    )
+    assert off["ok"], off.get("failure")
+    assert off["digests"] == default["digests"]

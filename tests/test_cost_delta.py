@@ -159,6 +159,48 @@ class TestChurnParity:
         assert stats["cols_rebuilt"] <= 2
         assert stats["rows_rebuilt"] == 0
 
+    def test_ingest_hints_force_hinted_cells_dirty(self, delta_env):
+        """The continuous-ingest seam (POSEIDON_STREAMING): hints
+        installed via set_round_hints union into the next build's dirty
+        sets — an UNCHANGED plane still rebuilds exactly the hinted
+        row/column (correct either way; the hint only spends work), and
+        unknown identities cost nothing."""
+        rng = np.random.default_rng(11)
+        state = _cluster(24, rng)
+        uidc = [0]
+        # 8 shapes -> 8 EC rows: one hinted row + one hinted column
+        # stays under the dirty-fraction gate (a 1-row plane would trip
+        # it and full-rebuild, proving nothing about the seam).
+        shapes = [(300 + 50 * i, (1 << 19) + (i << 12)) for i in range(8)]
+        _submit(state, uidc, 40, rng, shapes)
+        model = get_cost_model("cpu_mem")
+        cache = CostPlaneCache(model)
+        view = state.build_round_view()
+        cache.build(0, view.ecs, view.machines)
+
+        hint_ec = int(view.ecs.ec_ids[0])
+        hint_uuid = view.machines.uuids[5]
+        # Watcher-thread half (additive), then the round's install —
+        # plus identities no band contains, which must be skipped free.
+        cache.ingest(ec_ids=[hint_ec])
+        cache.set_round_hints([hint_ec, 999_999_999],
+                              [hint_uuid, "no-such-machine"])
+        got = cache.build(0, view.ecs, view.machines)
+        want = model.build(view.ecs, view.machines)
+        assert (got.costs == want.costs).all()
+        stats = cache.last_stats
+        assert stats["path"] == "delta", stats
+        assert cache.ingest_hints_applied >= 2
+        assert 0 in stats["dirty_rows"].tolist()
+        assert 5 in stats["dirty_cols"].tolist()
+
+        # Hints persist until replaced (every band's build this round
+        # sees them); an empty install clears the seam.
+        cache.set_round_hints([], [])
+        cache.build(0, view.ecs, view.machines)
+        assert cache.last_stats["cols_rebuilt"] == 0
+        assert cache.last_stats["rows_rebuilt"] == 0
+
     def test_interner_identity_change_falls_back_to_oracle(
             self, delta_env, monkeypatch):
         """Resident-interner compaction installs new id dicts, remapping

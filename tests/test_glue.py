@@ -48,6 +48,23 @@ class TestKeyedQueue:
         key3, items3 = q.get()
         assert (key3, items3) == ("a", [2])
 
+    def test_oldest_age_tracks_undelivered_head(self):
+        """The ingest-lag gauge's source: age of the oldest key still
+        waiting for delivery — None when nothing waits, re-armed when a
+        parked key's items re-enter the ready set."""
+        q = KeyedQueue()
+        assert q.oldest_age_s() is None
+        q.add("a", 1)
+        age = q.oldest_age_s()
+        assert age is not None and age >= 0.0
+        q.get()  # "a" delivered (processing)
+        assert q.oldest_age_s() is None
+        q.add("a", 2)  # parks behind the in-flight batch
+        q.done("a")  # parked items re-enter; lag clock restarts here
+        assert q.oldest_age_s() is not None
+        q.get()
+        assert q.oldest_age_s() is None
+
     def test_shutdown_unblocks(self):
         q = KeyedQueue()
         out = []

@@ -103,6 +103,62 @@ class TestNodeStateMachine:
         assert st.tasks[1].state == TaskState.RUNNABLE
 
 
+class TestContinuousIngest:
+    """The streaming admission layer: arrival accounting the round
+    engine cuts at view-build time (POSEIDON_STREAMING)."""
+
+    def test_admission_cut_counts_and_resets(self):
+        st = ClusterState()
+        assert st.ingest_age_s() is None  # unarmed before any arrival
+        st.node_added(mk_machine("m-0"))
+        st.task_submitted(mk_task(1))
+        st.task_submitted(mk_task(2))
+        assert st.ingest_age_s() is not None
+        admitted, age = st.admission_cut()
+        assert admitted == 3  # node + 2 tasks
+        assert age >= 0.0
+        # The cut reset the window: nothing pending, next cut is empty.
+        assert st.pending_ingest() == 0
+        assert st.admission_cut() == (0, 0.0)
+
+    def test_late_arrivals_defer_then_land_next_round(self):
+        """The bounded-staleness contract: a delta arriving AFTER the
+        cut is this round's ``admission_deferred`` — and the next cut
+        (round N+1) admits it, so nothing defers more than one round."""
+        st = ClusterState()
+        st.node_added(mk_machine("m-0"))
+        st.admission_cut()  # round N's view snapshot
+        st.task_submitted(mk_task(7))  # arrives mid-round
+        assert st.pending_ingest() == 1  # -> metrics.admission_deferred
+        # The live state ALREADY holds the task (watchers applied it);
+        # only the accounting deferred it.
+        assert 7 in st.tasks
+        admitted, _ = st.admission_cut()  # round N+1's snapshot
+        assert admitted == 1
+        assert st.pending_ingest() == 0
+
+    def test_scheduler_commits_are_not_ingest(self):
+        """apply_placement is the scheduler's own round commit, not an
+        external arrival — it must not look like watcher ingest (it
+        would hold staleness permanently high on a busy cluster)."""
+        st = ClusterState()
+        st.node_added(mk_machine("m-0"))
+        st.task_submitted(mk_task(1))
+        st.admission_cut()
+        st.apply_placement(1, "m-0")
+        assert st.pending_ingest() == 0
+
+    def test_ingest_hints_accumulate_and_drain(self):
+        st = ClusterState()
+        st.node_added(mk_machine("m-0"))
+        st.task_submitted(mk_task(1))
+        rows, cols = st.take_ingest_hints()
+        assert "m-0" in cols
+        assert rows == {mk_task(1).ec_id}
+        # Drained: a second take is empty until the next mutation.
+        assert st.take_ingest_hints() == (set(), set())
+
+
 class TestECSignature:
     def test_identical_tasks_share_ec(self):
         a = mk_task(1, cpu=100, ram=500)

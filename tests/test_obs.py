@@ -498,10 +498,18 @@ def test_round_metrics_round_trip():
 
     m = RoundMetrics(round_index=3, num_tasks=10, solve_seconds=1.5,
                      gap_bound=float("inf"), solve_tier="host_greedy",
-                     converged=False)
+                     converged=False,
+                     overlap_fraction=0.25, admission_deferred=3,
+                     admission_staleness_s=0.125,
+                     placements_per_sec=42.5)
     d = m.to_dict()
     assert d["schema"] == RoundMetrics.SCHEMA
     assert d["gap_bound"] == "inf"  # JSON-safe
+    # The streaming-engine series ride the same wire format.
+    assert d["overlap_fraction"] == 0.25
+    assert d["admission_deferred"] == 3
+    assert d["admission_staleness_s"] == 0.125
+    assert d["placements_per_sec"] == 42.5
     wire = json.loads(json.dumps(d))  # survives a real serialization
     m2 = RoundMetrics.from_dict(wire)
     assert m2 == m
@@ -632,6 +640,45 @@ def test_perf_gate_never_compares_apples_to_oranges():
     res = bench_compare.compare(_artifact(), missing)
     assert res["comparable"]
     assert "features.gang.solve_s" in res["skipped"]
+
+
+def test_perf_gate_refuses_streaming_vs_synchronous():
+    """A streaming-engine artifact's throughput series measure a
+    continuously-overlapped loop — never diffable against a round-
+    synchronous baseline's numbers (mirrors the solver-tier guard)."""
+    stream = _artifact(
+        mode="streaming",
+        throughput={"mode": "streaming", "placements_per_sec": 300.0},
+    )
+    res = bench_compare.compare(_artifact(), stream)
+    assert not res["comparable"]
+    assert "mode mismatch" in res["reason"]
+    # Artifacts predating the marker default to synchronous.
+    res = bench_compare.compare(stream, _artifact())
+    assert not res["comparable"]
+
+
+def test_perf_gate_throughput_series_direction():
+    """placements_per_sec gates INVERTED relative to the timing rows:
+    regression when the current run places SLOWER than baseline."""
+    base = _artifact(
+        mode="streaming",
+        throughput={"mode": "streaming", "placements_per_sec": 300.0},
+    )
+    same = copy.deepcopy(base)
+    res = bench_compare.compare(base, same)
+    assert res["comparable"] and res["regressions"] == []
+    assert "throughput.placements_per_sec" in {r["name"] for r in res["rows"]}
+
+    slower = copy.deepcopy(base)
+    slower["throughput"]["placements_per_sec"] = 100.0
+    res = bench_compare.compare(base, slower)
+    assert res["regressions"] == ["throughput.placements_per_sec"]
+
+    faster = copy.deepcopy(base)
+    faster["throughput"]["placements_per_sec"] = 900.0
+    res = bench_compare.compare(base, faster)
+    assert res["regressions"] == []
 
 
 def test_perf_gate_cli_exit_codes(tmp_path, capsys):
@@ -815,6 +862,35 @@ def test_healthz_liveness_report(monkeypatch):
         assert live["consecutive_failures"] == 1
         assert live["crash_loop_budget"] == 4
         assert live["resyncs"] == 3
+
+        # Watcher ingest liveness: before any watch event the age is
+        # null (the wedge gate is unarmed — a cluster with no churn is
+        # healthy); after one it is a real age.
+        assert live["last_ingest_age_s"] is None
+        obs_metrics.watch_event("pod", "ADDED", registry=reg)
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as resp:
+            ingested = json.loads(resp.read())
+        assert ingested["ok"] is True
+        assert ingested["last_ingest_age_s"] is not None
+
+        # Streaming mode + stalled ingest -> 503 with the stall marker
+        # (the loop itself is fine — speculative rounds still complete —
+        # but a wedged watcher thread means the world is going stale).
+        monkeypatch.setenv("POSEIDON_STREAMING", "1")
+        monkeypatch.setenv("POSEIDON_INGEST_STALL_S", "0.000001")
+        time.sleep(0.01)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/healthz", timeout=5)
+        assert exc.value.code == 503
+        stalled = json.loads(exc.value.read())
+        assert stalled["ingest_stalled"] is True
+        assert stalled["loop_fatal"] is False
+        # Synchronous mode never trips the gate: the round loop's own
+        # drain_watchers barrier bounds staleness there.
+        monkeypatch.delenv("POSEIDON_STREAMING")
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as resp:
+            assert json.loads(resp.read())["ok"] is True
+        monkeypatch.delenv("POSEIDON_INGEST_STALL_S")
 
         # A fatal loop stop fails liveness with 503.
         obs_metrics.observe_loop(stats, resyncs=3, crash_loop_budget=4,

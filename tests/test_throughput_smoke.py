@@ -1,0 +1,50 @@
+"""Streaming-throughput smoke (``make throughput-smoke``).
+
+A tiny fixed-duration run of ``bench.run_throughput`` — the sustained-
+throughput rung's child — through the FULL stack (fake kube, watchers,
+gRPC service, streaming glue loop): placements/sec must be positive in
+both modes, the fixed-round identity legs must produce byte-identical
+placement digests streaming-vs-synchronous, and the warm windows of
+both duration legs must compile nothing fresh.
+
+Slow-marked: excluded from the tier-1 gate, run via
+``make throughput-smoke`` (wired into ``make verify``) or
+``pytest -m slow``.
+"""
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def test_throughput_rung_smoke():
+    import bench
+
+    out = bench.run_throughput(machines=48, seconds=3.0, seed=0)
+    assert out["ok"], out.get("error", out)
+
+    # The identity legs: 6 per-round-drained rounds, streaming and
+    # synchronous kube truth byte-identical round for round.
+    assert out["identity_ok"], out.get("error")
+    assert out["identity_rounds"] == 6
+
+    # The duration legs actually moved work in both modes.
+    assert out["placements_per_sec"] > 0
+    assert out["placements_per_sec_sync"] > 0
+    assert out["streaming"]["rounds"] > 0
+    assert out["synchronous"]["rounds"] > 0
+
+    # Warm overlapped rounds stay inside the compile discipline: the
+    # session marks warm at round 2 and counts fresh compiles after.
+    assert out["streaming"]["warm_fresh_compiles"] == 0
+    assert out["synchronous"]["warm_fresh_compiles"] == 0
+
+    # The artifact self-identifies as a streaming-mode measurement so
+    # tools/bench_compare.py can refuse apples-to-oranges diffs.
+    assert out["mode"] == "streaming"
+
+    # Overlap is only ever realized by the streaming engine — the
+    # synchronous legs must report none (the fraction itself is
+    # hardware-dependent, so no floor is asserted here; PERF.md carries
+    # the honest measured numbers).
+    assert out["synchronous"]["overlap_fraction_mean"] == 0.0

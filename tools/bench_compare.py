@@ -119,6 +119,17 @@ def _config_key(art: dict) -> Tuple:
     )
 
 
+def _mode_key(art: dict) -> str:
+    """Round-engine fingerprint for the comparability guard: a
+    streaming-mode artifact's series (sustained placements/sec,
+    overlap-credited round timings) measure a continuously-overlapped
+    loop, not the round-synchronous one — diffing them against a
+    synchronous baseline compares two different engines.  Artifacts
+    predating the ``mode`` marker are synchronous by construction."""
+    mode = art.get("mode") or (art.get("throughput") or {}).get("mode")
+    return mode if mode == "streaming" else "synchronous"
+
+
 def _solver_key(art: dict) -> str:
     """Solver-tier fingerprint for the comparability guard: a rung any
     of whose rounds the SHARDED tier served splits device work over a
@@ -214,6 +225,18 @@ def compare(
             ),
             "rows": [], "skipped": [], "regressions": [],
         }
+    base_mode, cur_mode = _mode_key(baseline), _mode_key(current)
+    if base_mode != cur_mode:
+        return {
+            "comparable": False,
+            "reason": (
+                f"mode mismatch: baseline {base_mode} vs current "
+                f"{cur_mode} — a streaming-engine artifact's throughput "
+                "series measure a continuously-overlapped loop, "
+                "apples-to-oranges against round-synchronous numbers"
+            ),
+            "rows": [], "skipped": [], "regressions": [],
+        }
     base_solver, cur_solver = _solver_key(baseline), _solver_key(current)
     if base_solver != cur_solver:
         return {
@@ -264,6 +287,27 @@ def compare(
             "name": name, "baseline_s": b, "current_s": c,
             "ratio": round(ratio, 3), "verdict": verdict,
         })
+    # Sustained throughput (streaming rung): direction is INVERTED —
+    # placements/sec falling below the baseline's band is the
+    # regression.  Both sides carry the same mode (the guard above), so
+    # the number is commensurable when present on both.
+    base_tp = (baseline.get("throughput") or {}).get("placements_per_sec")
+    cur_tp = (current.get("throughput") or {}).get("placements_per_sec")
+    if isinstance(base_tp, (int, float)) and isinstance(cur_tp, (int, float)):
+        ratio = (cur_tp / base_tp) if base_tp > 0 else float("inf")
+        verdict = "ok"
+        if cur_tp < base_tp * (1.0 - tolerance):
+            verdict = "regression"
+            regressions.append("throughput.placements_per_sec")
+        elif cur_tp > base_tp * (1.0 + tolerance):
+            verdict = "improved"
+        rows.append({
+            "name": "throughput.placements_per_sec",
+            "baseline_s": float(base_tp), "current_s": float(cur_tp),
+            "ratio": round(ratio, 3), "verdict": verdict,
+        })
+    elif isinstance(base_tp, (int, float)) or isinstance(cur_tp, (int, float)):
+        skipped.append("throughput.placements_per_sec")
     return {
         "comparable": True, "reason": None, "rows": rows,
         "skipped": sorted(skipped), "regressions": regressions,
