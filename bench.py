@@ -623,6 +623,7 @@ def run_features(machines: int, rounds: int) -> dict:
 
     from poseidon_tpu.check.ledger import (
         CompileLedger,
+        NumericsLedger,
         TransferLedger,
     )
     from poseidon_tpu.costmodel import get_cost_model
@@ -695,8 +696,15 @@ def run_features(machines: int, rounds: int) -> dict:
             # round") enforced in-band — a retrace regression fails the
             # bench with the compiled program names, instead of hiding
             # in round_p50_s the way the 15.2 s gang round did.
+            # The numerics window rides next to the compile/transfer
+            # ones: validating every host_fetch leaf (finite floats,
+            # int32 fetch headroom) at budget 0, so a wrapped or
+            # saturated solver value fails the bench naming the
+            # offending array instead of corrupting placements.
             with CompileLedger(budget=0, label=f"warm selector round {r}"), \
                     TransferLedger(
+                        budget=0, label=f"warm selector round {r}"), \
+                    NumericsLedger(
                         budget=0, label=f"warm selector round {r}"):
                 _, m = planner.schedule_round()
         lat.append(time.perf_counter() - t0)
@@ -825,7 +833,8 @@ def run_features(machines: int, rounds: int) -> dict:
     # 10k) — so a fresh compile here IS the silent-retrace bug class,
     # asserted at budget 0 exactly like the warm rounds.
     with CompileLedger(budget=0, label="gang round"), \
-            TransferLedger(budget=0, label="gang round"):
+            TransferLedger(budget=0, label="gang round"), \
+            NumericsLedger(budget=0, label="gang round"):
         _, mg = planner.schedule_round()
     gang_s = time.perf_counter() - t0
     partial_gangs = placed_gangs = 0
@@ -1179,6 +1188,73 @@ def run_parity() -> dict:
 CLUSTER_RUNG = (100_000, 1_000_000)
 
 
+def run_saturation_probe(E: int = 32, M: int = 16,
+                         max_cost: int = 400) -> dict:
+    """Drive aggregate supply to the int32 cliff and prove the
+    numerics-discipline suite never wraps silently (the cluster rung's
+    saturation leg; also run tiny by the bench smoke test).
+
+    Two legs, covering both rails of the contract:
+
+    - PAST the cliff: a supply vector whose int64 total leaves the
+      certified int32 band must be REFUSED at dispatch by the
+      host-boundary flow-sum certificate
+      (``utils.numerics.certify_i32_total`` raising
+      ``SaturationError``) — the in-kernel int32 flow reductions it
+      covers would wrap.
+    - AT the cliff: a dispatchable instance whose in-iteration active
+      excess crosses 2^30 must come back with the telemetry ring's
+      saturating lane CLAMPED AND FLAGGED (``_TR_SAT``), and the
+      rail-riding fetched ring must be caught by the open
+      ``NumericsLedger`` window.  The excess total stays positive
+      everywhere — the silent two's-complement wrap this PR's telemetry
+      fix removed is structurally unreachable.
+
+    ``ok`` requires the certificate trip, the saturation flag, the
+    ledger attribution, and no negative excess/flow anywhere."""
+    from poseidon_tpu.check.ledger import NumericsLedger
+    from poseidon_tpu.ops.transport import solve_transport
+    from poseidon_tpu.utils.numerics import I32_MAX, SaturationError
+
+    rng = np.random.default_rng(0)
+    costs = rng.integers(0, max_cost, size=(E, M)).astype(np.int32)
+    unsched = np.full(E, 5 * max_cost, dtype=np.int32)
+    out: dict = {"E": E, "M": M, "ok": False}
+
+    # Leg 1: past the cliff — dispatch must be refused, never solved.
+    hot_supply = np.full(E, (1 << 31) // E, dtype=np.int32)
+    capacity = np.full(M, 100_000_000 // M, dtype=np.int32)
+    try:
+        solve_transport(costs, hot_supply, capacity, unsched)
+        out["certificate_tripped"] = False
+    except SaturationError:
+        out["certificate_tripped"] = True
+
+    # Leg 2: at the cliff — solvable, saturating, flagged, attributed.
+    supply = np.full(E, 2_000_000_000 // E, dtype=np.int32)
+    with NumericsLedger(budget=None, label="saturation probe") as led:
+        sol = solve_transport(costs, supply, capacity, unsched)
+    t = sol.telemetry
+    sat_samples = int(t.saturated_samples()) if t is not None else 0
+    max_excess = int(t.active_excess.max()) if t is not None else 0
+    min_excess = int(t.active_excess.min()) if t is not None else 0
+    out.update(
+        saturated_samples=sat_samples,
+        ledger_anomalies=led.anomalies,
+        max_active_excess=max_excess,
+        excess_headroom_to_rail=I32_MAX - max_excess,
+        wrap_observed=bool(min_excess < 0 or int(sol.flows.min()) < 0),
+        ok=bool(
+            out["certificate_tripped"]
+            and sat_samples > 0
+            and led.anomalies > 0
+            and min_excess >= 0
+            and int(sol.flows.min()) >= 0
+        ),
+    )
+    return out
+
+
 def run_cluster_rung(machines: int, tasks: int, ecs: int, rounds: int,
                      verbose: bool) -> dict:
     """The cluster-scale rung (default 100k machines / 1M tasks,
@@ -1256,6 +1332,23 @@ def run_cluster_rung(machines: int, tasks: int, ecs: int, rounds: int,
         print(f"# [cluster] parity {p_machines}/{p_tasks}: "
               f"sharded={m_sh.objective} ({m_sh.solve_tier}) "
               f"dense={m_dn.objective} ok={parity_ok}", file=sys.stderr)
+
+    # ---- saturation leg: capacities/supplies at the int32 cliff must
+    # trip the dispatch certificate, the telemetry saturation flag, or
+    # the numerics ledger — never wrap silently.  Tiny instance (the
+    # hazard is aggregate magnitude, not matrix width), so the leg
+    # costs seconds at any rung scale.
+    saturation = run_saturation_probe()
+    partial.update(
+        saturation=saturation, partial="after saturation probe"
+    )
+    print(json.dumps(partial), flush=True)
+    if verbose:
+        print(f"# [cluster] saturation: cert="
+              f"{saturation['certificate_tripped']} "
+              f"sat_samples={saturation['saturated_samples']} "
+              f"anomalies={saturation['ledger_anomalies']} "
+              f"ok={saturation['ok']}", file=sys.stderr)
 
     # ---- the cluster-scale rung itself.
     state = build_cluster(machines, tasks, ecs, seed=0)
@@ -1337,6 +1430,7 @@ def run_cluster_rung(machines: int, tasks: int, ecs: int, rounds: int,
         "parity_objective": int(m_sh.objective),
         "parity_dense_objective": int(m_dn.objective),
         "sharded_parity_ok": parity_ok,
+        "saturation": saturation,
         # Per-device work series (machine-independent counts).
         "device_calls": wave_device_calls,
         "solve_iters": wave_solve_iters,
@@ -1348,7 +1442,7 @@ def run_cluster_rung(machines: int, tasks: int, ecs: int, rounds: int,
         "unscheduled": unsched,
         "objective": objective,
         "converged": converged,
-        "ok": bool(parity_ok and converged),
+        "ok": bool(parity_ok and converged and saturation["ok"]),
     }
 
 

@@ -216,8 +216,10 @@ def run_soak(
     injector) so a test can, e.g., kill the Firmament stub mid-soak.
     """
     from poseidon_tpu.check.ledger import (
+        NumericsLedger,
         fresh_compile_count,
         implicit_transfer_count,
+        numeric_anomaly_count,
     )
     from poseidon_tpu.glue.fake_kube import FakeKube, Node, Pod
     from poseidon_tpu.glue.poseidon import Poseidon
@@ -249,7 +251,8 @@ def run_soak(
         "rounds_requested": rounds, "rounds_run": 0,
         "families_covered": list(fault_plan.families_covered()),
         "digests": [], "warm_fresh_compiles": 0,
-        "warm_implicit_transfers": 0, "warm_lock_order_edges": [],
+        "warm_implicit_transfers": 0, "warm_numeric_anomalies": 0,
+        "warm_lock_order_edges": [],
         "lock_contention_ns": 0, "tiers": [],
         "divergent_rounds": 0, "cost_delta_hits": 0,
     }
@@ -302,8 +305,17 @@ def run_soak(
     # the rest of the process.
     _tracer = obs_trace.tracer()
     _prev_force = _tracer.force
+    # Numerics-ledger window over the WHOLE soak: every host_fetch the
+    # soak drives is validated (finite floats, int32 fetch headroom) and
+    # every saturation-certificate trip attributed.  Telemetry mode
+    # (budget=None): the per-round counter diffs and the end-of-soak
+    # SoakFailure gate own the budget-0 assertion, so a numeric anomaly
+    # fails through the flight-recorder path like every other gate
+    # instead of as a bare exception out of a round body.
+    _numled = NumericsLedger(budget=None, label="chaos soak")
     try:
         _tracer.force = True
+        _numled.__enter__()
         obs_trace.drain_spans()  # a clean window: drop pre-soak spans
         obs_trace.drain_counter_samples()
         for node_i in range(machines):
@@ -386,6 +398,7 @@ def run_soak(
             transfers0 = implicit_transfer_count()
             edges0 = lock_order_edge_count()
             contention0 = lock_contention_ns()
+            anoms0 = numeric_anomaly_count()
             for _attempt in range(2 * (cfg.crash_loop_budget + 1)):
                 delay = poseidon.try_round()
                 if delay is None:
@@ -413,6 +426,7 @@ def run_soak(
                 # (the policy fired; sleeping it for real buys nothing).
             fresh = fresh_compile_count() - fresh0
             transfers = implicit_transfer_count() - transfers0
+            anoms = numeric_anomaly_count() - anoms0
             new_edges = lock_order_edges()[edges0:]
             if r >= 1:
                 result["warm_fresh_compiles"] += fresh
@@ -421,6 +435,12 @@ def run_soak(
                 # syncs is the same silent-latency bug class
                 # (TransferLedger; posecheck transfer-discipline).
                 result["warm_implicit_transfers"] += transfers
+                # Fourth budget-0 gate (NumericsLedger): the soak-wide
+                # window validates every fetched value, so a warm-round
+                # anomaly means a solve handed the planner a non-finite
+                # or rail-riding number — silent corruption, the
+                # numeric twin of a fresh compile in a warm round.
+                result["warm_numeric_anomalies"] += anoms
                 # Third budget-0 gate (LockLedger): round 0 latches the
                 # steady-state lock-acquisition-order graph; a WARM
                 # round growing it means a thread explored a nesting no
@@ -460,6 +480,7 @@ def run_soak(
             # planner's own solve window — record both.
             metrics_d["soak_fresh_compiles"] = fresh
             metrics_d["soak_implicit_transfers"] = transfers
+            metrics_d["soak_numeric_anomalies"] = anoms
             metrics_d["soak_lock_order_edges"] = len(new_edges)
             metrics_d["soak_lock_contention_ns"] = (
                 lock_contention_ns() - contention0
@@ -541,6 +562,15 @@ def run_soak(
                     "device->host sync(s) in warm rounds (budget 0)",
                     total_rounds,
                 )
+            if result["warm_numeric_anomalies"]:
+                raise SoakFailure(
+                    "numeric-anomalies",
+                    f"{result['warm_numeric_anomalies']} numeric "
+                    "anomaly(ies) in warm rounds (budget 0): a fetched "
+                    "value was non-finite or rode the int32 rails — see "
+                    "the NumericsLedger offenders in the flight trace",
+                    total_rounds,
+                )
             if result["warm_lock_order_edges"]:
                 raise SoakFailure(
                     "lock-order-edges",
@@ -563,6 +593,7 @@ def run_soak(
         log.error("soak failed (%s); flight trace: %s",
                   e, result["trace_path"])
     finally:
+        _numled.__exit__(None, None, None)  # no-op if never entered
         _tracer.force = _prev_force
         poseidon.stop()
         try:

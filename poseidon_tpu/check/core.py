@@ -127,6 +127,9 @@ def all_rules() -> List[Rule]:
     from poseidon_tpu.check.hatch_registry import HatchRegistryRule
     from poseidon_tpu.check.jit_purity import JitPurityRule
     from poseidon_tpu.check.lock_discipline import LockDisciplineRule
+    from poseidon_tpu.check.numerics_discipline import (
+        NumericsDisciplineRule,
+    )
     from poseidon_tpu.check.retrace_guard import RetraceGuardRule
     from poseidon_tpu.check.shard_discipline import ShardDisciplineRule
     from poseidon_tpu.check.transfer_discipline import (
@@ -145,6 +148,7 @@ def all_rules() -> List[Rule]:
         LockOrderRule(),
         BlockingUnderLockRule(),
         UnsafePublicationRule(),
+        NumericsDisciplineRule(),
     ]
 
 

@@ -1,0 +1,58 @@
+"""Clean counterpart for posecheck `numerics` (never imported).
+
+Every hazard class from numerics_violations.py, written the sanctioned
+way: widened accumulators, certified widen/narrow helpers, clamp-before-
+cast, sentinel planes consumed through guards or min/max reductions,
+dtype-consistent jitted arithmetic, and one documented bound riding a
+justified suppression.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from poseidon_tpu.utils.numerics import checked_narrow_i32, widen_counts
+
+INF_COST = 1 << 28
+
+
+def widened_totals():
+    counts = np.zeros((4, 8), dtype=np.int32)
+    total = np.sum(counts, dtype=np.int64)          # widened accumulator
+    wide = widen_counts(counts, site="fixture.counts")
+    grand = wide.sum()                              # int64 input
+    return total, grand
+
+
+def bounded_narrows(free, req):
+    big = np.iinfo(np.int32).max // 4
+    n = np.floor(free / np.maximum(req, 1e-9))
+    n = np.minimum(n, big)                          # clamp before the cast
+    cap = n.astype(np.int32)
+    clipped = np.clip(np.floor(free / req), 0, big).astype(np.int32)
+    certified = checked_narrow_i32(free, site="fixture.free", hi=big)
+    return cap, clipped, certified
+
+
+def guarded_sentinels(base, forbidden):
+    plane = np.where(forbidden, INF_COST, base)     # construction is legal
+    worst = plane.max()                             # min/max stay legal
+    finite = np.where(plane >= INF_COST, 0, plane)  # integer guard
+    tot = np.sum(finite)
+    fin2 = np.where(np.isfinite(base), base, 0)     # float guard
+    tot2 = np.sum(fin2)
+    return worst, tot, tot2
+
+
+@jax.jit
+def consistent_kernel(a, b):
+    x = a.astype(jnp.float32)
+    y = b.astype(jnp.float32)
+    return x * y + 0.5                              # same family: fine
+
+
+def documented_bound():
+    counts = np.zeros(8, dtype=np.int32)
+    # Bounded by construction: eight zero cells cannot accumulate.
+    t = np.sum(counts)  # posecheck: ignore[numerics]
+    return t

@@ -1,0 +1,78 @@
+"""Seeded violations for posecheck `numerics` (never imported, only parsed).
+
+Expected findings (12):
+- i32-overflow (5): int32 `np.sum` reduction, int32 `.cumsum()` method
+  reduction, `*` between two int32-tagged arrays, narrowing
+  `astype(int32)` of a float-ish tracked name, narrowing
+  `astype(int32)` directly on a `np.floor(...)` chain.
+- inf-sentinel (4): `+` through a locally seeded INF_COST plane,
+  `np.sum` over that plane, `-` through a plane returned by a jitted
+  producer (cross-function lattice), `np.sum` over that returned plane.
+- promotion (3): f32/i32 Name-vs-Name mix inside a jitted def, Python
+  float literal against an int32-tagged operand inside a jitted def,
+  Python float literal passed positionally at a jit call boundary.
+
+Two seeded hazards carry `# posecheck: ignore[numerics]` (one per-file
+i32 reduction, one finalize-path sentinel binop) and must NOT count.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+INF_COST = 1 << 28
+
+
+def overflowing_counts(counts2):
+    counts = np.zeros((4, 8), dtype=np.int32)
+    total = np.sum(counts)                  # VIOLATION: i32 sum
+    running = counts.cumsum()               # VIOLATION: i32 cumsum
+    other = np.ones((4, 8), dtype=np.int32)
+    pairs = counts * other                  # VIOLATION: i32 * i32 product
+    # Documented bound: the fixture matrix is 4x8 of zeros.
+    bounded = np.sum(counts)  # posecheck: ignore[numerics]
+    return total, running, pairs, bounded
+
+
+def narrowing_casts(free, req):
+    n = np.floor(free / np.maximum(req, 1e-9))
+    cap = n.astype(np.int32)                # VIOLATION: unclamped narrow
+    cap2 = np.floor(free / req).astype(np.int32)   # VIOLATION: same, inline
+    return cap, cap2
+
+
+def hot_total(base, forbidden, penalty):
+    plane = np.where(forbidden, INF_COST, base)
+    tot = plane + penalty                   # VIOLATION: + through sentinels
+    s = np.sum(plane)                       # VIOLATION: sum mixes sentinels
+    # Justified: the fixture pretends a downstream isfinite guard.
+    t2 = plane + penalty  # posecheck: ignore[numerics]
+    safe = np.where(plane >= INF_COST, 0, plane)
+    ok = np.sum(safe)                       # clean: integer-guarded
+    return tot, s, t2, ok
+
+
+@jax.jit
+def _seed_plane(c):
+    p = jnp.where(c > 9, INF_COST, c)
+    return p
+
+
+def consume(c, drift):
+    out = _seed_plane(c)
+    bad = out - drift                       # VIOLATION: via jitted producer
+    tot = np.sum(out)                       # VIOLATION: via jitted producer
+    return bad, tot
+
+
+@jax.jit
+def mix(a, b):
+    x = a.astype(jnp.float32)
+    y = b.astype(jnp.int32)
+    xy = x * y                              # VIOLATION: f32 * i32 mix
+    z = y * 0.5                             # VIOLATION: weak float vs i32
+    return xy + z
+
+
+def boundary_caller(a):
+    return mix(a, 2.5)                      # VIOLATION: weak literal at jit

@@ -403,3 +403,159 @@ class TransferLedger:
                 "transfer-discipline names the static patterns)."
             )
         return False
+
+
+# ------------------------------------------------------------- numerics
+
+# Runtime twin of the ``posecheck numerics`` static rule
+# (check/numerics_discipline.py): validate what actually crosses the
+# declared device->host boundary.  The static rule names the int32
+# overflow / inf-sentinel / promotion *patterns*; this ledger catches
+# the *values* — a non-finite float or an int32 riding the rails at
+# ``transport.host_fetch`` — with the same budget-0 window contract as
+# the compile/transfer/lock ledgers.  Validation is off unless the
+# POSEIDON_NUMERICS_LEDGER hatch is on or a NumericsLedger window is
+# open, so production fetches pay only one dict probe.
+
+# Declared int32 headroom at the fetch boundary: legit solver values
+# stay at or below the 2^30 price/sentinel rails (_NEG/_POS, INF_COST,
+# PRICE_SPREAD_CAP are all <= 1<<30); a fetched value inside the last
+# 2^20 below the int32 rails is either a wrapped accumulation or a
+# saturation-clamped one — both are anomalies to surface, never to
+# pass silently.
+I32_FETCH_HEADROOM = 1 << 20
+_I32_HI = (1 << 31) - 1 - I32_FETCH_HEADROOM
+_I32_LO = -(1 << 31) + I32_FETCH_HEADROOM
+
+_numeric_count = 0
+_numerics_active: List["NumericsLedger"] = []
+
+
+def numeric_anomaly_count() -> int:
+    """Process-wide count of numeric anomalies (non-finite floats or
+    int32 headroom violations at the host_fetch boundary, plus
+    utils.numerics certificate trips).  Difference around a window
+    exactly like ``fresh_compile_count`` —
+    ``RoundMetrics.numeric_anomalies`` is wired this way."""
+    return _numeric_count
+
+
+def note_numeric_anomaly(desc: str) -> None:
+    """Record one numeric anomaly (also called by utils.numerics when a
+    saturation certificate trips)."""
+    global _numeric_count
+    with _lock:
+        _numeric_count += 1
+        for led in _numerics_active:
+            led._note(desc)
+
+
+def numerics_enabled() -> bool:
+    """Is boundary validation on?  True under the
+    ``POSEIDON_NUMERICS_LEDGER`` hatch or inside any open
+    ``NumericsLedger`` window — ``transport.host_fetch`` consults this
+    before paying the array scans."""
+    if _numerics_active:
+        return True
+    from poseidon_tpu.utils.hatches import hatch_bool
+
+    return hatch_bool("POSEIDON_NUMERICS_LEDGER")
+
+
+def _validate_leaf(arr, site: str) -> None:
+    import numpy as _np
+
+    a = _np.asarray(arr)
+    if a.size == 0:
+        return
+    if _np.issubdtype(a.dtype, _np.floating):
+        bad = ~_np.isfinite(a)
+        if bad.any():
+            note_numeric_anomaly(
+                f"{site}: non-finite {a.dtype}{list(a.shape)} "
+                f"({int(bad.sum())} element(s), first at index "
+                f"{tuple(int(i) for i in _np.argwhere(bad)[0])})"
+            )
+    elif a.dtype == _np.int32:
+        lo, hi = int(a.min()), int(a.max())
+        if lo < _I32_LO or hi > _I32_HI:
+            note_numeric_anomaly(
+                f"{site}: int32{list(a.shape)} within {I32_FETCH_HEADROOM} "
+                f"of the int32 rails (min={lo}, max={hi}) — a wrapped or "
+                "saturation-clamped accumulation"
+            )
+
+
+def maybe_validate_fetched(values, site: str = "host_fetch") -> None:
+    """Validate a fetched pytree when numerics validation is enabled:
+    floats must be finite, int32 must hold the declared fetch headroom.
+    Anomalies are counted (and attributed to open ledgers), never
+    raised here — the budget assertion belongs to the window's exit, so
+    a fetch inside a telemetry-mode window still completes."""
+    if not numerics_enabled():
+        return
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(values):
+        if hasattr(leaf, "dtype") and hasattr(leaf, "shape"):
+            try:
+                _validate_leaf(leaf, site)
+            except Exception:  # noqa: BLE001 - validation must never break a fetch
+                pass
+
+
+class NumericsBudgetExceeded(AssertionError):
+    """A ledger window observed more numeric anomalies than budgeted."""
+
+
+class NumericsLedger:
+    """Context manager asserting a numeric-anomaly budget.
+
+    >>> with NumericsLedger(budget=0, label="warm gang round"):
+    ...     planner.schedule_round()
+
+    While the window is open, every ``transport.host_fetch`` /
+    ``_fetch_with_retry`` boundary crossing is validated (finiteness for
+    floats, declared int32 headroom for int32) and every
+    ``utils.numerics`` saturation-certificate trip is attributed to the
+    window.  ``budget=None`` records without asserting (telemetry
+    mode).  The assertion is raised from ``__exit__`` only when the body
+    itself did not raise, naming each offender by array/site."""
+
+    def __init__(self, budget: Optional[int] = 0, label: str = ""):
+        self.budget = budget
+        self.label = label
+        self._anomalies = 0
+        self.offenders: List[str] = []
+
+    @property
+    def anomalies(self) -> int:
+        return self._anomalies
+
+    def _note(self, desc: str) -> None:
+        # Called under the module _lock (see note_numeric_anomaly).
+        self._anomalies += 1
+        if len(self.offenders) < 32:  # cap the report, not the count
+            self.offenders.append(desc)
+
+    def __enter__(self) -> "NumericsLedger":
+        with _lock:
+            _numerics_active.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        with _lock:
+            if self in _numerics_active:
+                _numerics_active.remove(self)
+        if exc_type is None and self.budget is not None \
+                and self._anomalies > self.budget:
+            where = f" in {self.label}" if self.label else ""
+            names = "; ".join(self.offenders) or "<not attributed>"
+            raise NumericsBudgetExceeded(
+                f"{self._anomalies} numeric anomaly(ies){where}, budget "
+                f"{self.budget}: {names}.  A value wrapped, saturated, "
+                "or went non-finite at the host boundary — posecheck "
+                "numerics names the static patterns; utils.numerics "
+                "carries the certified widening/narrowing helpers."
+            )
+        return False

@@ -163,7 +163,13 @@ class CpuMemCostModel(base.CostModel):
                     np.inf,
                 )
             n_fit_v = np.minimum(n_cpu_v, n_ram_v)
-            n_fit_v = np.where(np.isfinite(n_fit_v), n_fit_v, big_fit)
+            # Saturate at big_fit BEFORE the int32 cast: a finite fit
+            # count (huge free / tiny request) can exceed 2^31 and the
+            # bare astype would wrap it negative — an arc capacity of
+            # big_fit is already "unbounded" to the flow network.
+            n_fit_v = np.minimum(
+                np.where(np.isfinite(n_fit_v), n_fit_v, big_fit), big_fit
+            )
             arc_cap = np.zeros((E, M), dtype=np.int32)
             arc_cap[rows, cols] = n_fit_v.astype(np.int32)
         else:
@@ -197,7 +203,11 @@ class CpuMemCostModel(base.CostModel):
                     np.inf,
                 )
             n_fit = np.minimum(n_cpu, n_ram)
-            n_fit = np.where(np.isfinite(n_fit), n_fit, big_fit)
+            # Same saturation as the sparse path: finite fits past
+            # big_fit clamp instead of wrapping through astype(int32).
+            n_fit = np.minimum(
+                np.where(np.isfinite(n_fit), n_fit, big_fit), big_fit
+            )
             n_fit_i = n_fit.astype(np.int32)
             if dedup:
                 n_fit_i = n_fit_i[shape_inv]

@@ -95,6 +95,15 @@ class RoundMetrics:
     # boundary (transport.host_fetch) fetches explicitly and never
     # counts.
     implicit_transfers: int = 0
+    # Numeric anomalies observed in this round's solve window
+    # (check/ledger.numeric_anomaly_count diff — the NumericsLedger's
+    # process counter): non-finite floats or int32 values riding the
+    # rails at the transport.host_fetch boundary, plus utils.numerics
+    # saturation-certificate trips.  0 whenever validation is off
+    # (POSEIDON_NUMERICS_LEDGER unset and no ledger window open); must
+    # be 0 when it is on — a wrapped/saturated value is the silent-
+    # corruption twin of a fresh compile in a warm round.
+    numeric_anomalies: int = 0
     # Nanoseconds threads spent WAITING on tracked locks during this
     # round's solve window (utils/locks.py process counter diff): the
     # pipelining contract says the speculative cost build never blocks
@@ -834,6 +843,7 @@ class RoundPlanner:
                 device_calls=metrics.device_calls,
                 fresh_compiles=metrics.fresh_compiles,
                 implicit_transfers=metrics.implicit_transfers,
+                numeric_anomalies=metrics.numeric_anomalies,
                 repair_firings=metrics.repair_firings,
                 pruned_bands=metrics.pruned_bands,
                 pruned_width=metrics.pruned_width,
@@ -952,6 +962,7 @@ class RoundPlanner:
         from poseidon_tpu.check.ledger import (
             fresh_compile_count,
             implicit_transfer_count,
+            numeric_anomaly_count,
         )
         from poseidon_tpu.ops.transport import device_call_count
         from poseidon_tpu.utils.locks import lock_contention_ns
@@ -959,6 +970,7 @@ class RoundPlanner:
         calls0 = device_call_count()
         fresh0 = fresh_compile_count()
         transfers0 = implicit_transfer_count()
+        anomalies0 = numeric_anomaly_count()
         contention0 = lock_contention_ns()
         # Assignment pipelining: a finished band's EC->task assignment
         # (pure host work, ~0.5 s of a 10k fresh wave) runs on a worker
@@ -1039,6 +1051,7 @@ class RoundPlanner:
         metrics.device_calls = device_call_count() - calls0
         metrics.fresh_compiles = fresh_compile_count() - fresh0
         metrics.implicit_transfers = implicit_transfer_count() - transfers0
+        metrics.numeric_anomalies = numeric_anomaly_count() - anomalies0
         metrics.lock_contention_ns = lock_contention_ns() - contention0
         metrics.solve_seconds = time.perf_counter() - t_solve
         if metrics.gap_bound == float("inf"):

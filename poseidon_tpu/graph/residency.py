@@ -38,6 +38,8 @@ from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
+from poseidon_tpu.utils.numerics import widen_counts
+
 # Compact the (key, value) column space once it exceeds this many
 # columns AND dead (zero-count) columns are the majority: long-running
 # churn with rolling label vocabularies (version=v123, ...) must not
@@ -54,10 +56,17 @@ class ResidentCounts:
     ``total[m]`` = all residents (labelled or not).  The id dicts are
     snapshots: ids >= the matrix width (minted after this view was
     gathered) are treated as absent by the mask evaluators.
+
+    The count matrices arrive WIDENED to int64 through
+    ``utils.numerics.widen_counts``: the live index accumulates int32
+    (delta adds on the mutation hot path), and the once-per-round view
+    gather is where the saturation certificate is checked — a cell
+    outside the headroom band raises instead of letting downstream
+    selector reductions consume a wrapped count.
     """
 
-    kv_counts: np.ndarray               # int32 [M, Kkv]
-    key_counts: np.ndarray              # int32 [M, Kkey]
+    kv_counts: np.ndarray               # int64 [M, Kkv] (widened, certified)
+    key_counts: np.ndarray              # int64 [M, Kkey] (widened, certified)
     total: np.ndarray                   # int64 [M]
     kv_id: Dict[Tuple[str, str], int]
     key_id: Dict[str, int]
@@ -269,7 +278,11 @@ class ResidentLabelIndex:
         """Gather the live matrices into round machine-column order.
 
         The result is a copy: later index mutations (or compactions)
-        never disturb a round already in flight."""
+        never disturb a round already in flight.  The int32 count
+        gathers are widened to int64 through the saturation certificate
+        (utils.numerics.widen_counts): the per-round boundary where an
+        accumulation wrap is ruled out, so the int32 delta adds on the
+        mutation hot path never need per-add checks."""
         rows = np.fromiter(
             (self.row(u) for u in machine_uuids),
             dtype=np.int64, count=len(machine_uuids),
@@ -277,8 +290,14 @@ class ResidentLabelIndex:
         nkv = len(self.kv_id)
         nkey = len(self.key_id)
         return ResidentCounts(
-            kv_counts=self._kv[np.ix_(rows, np.arange(nkv))],
-            key_counts=self._key[np.ix_(rows, np.arange(nkey))],
+            kv_counts=widen_counts(
+                self._kv[np.ix_(rows, np.arange(nkv))],
+                site="residency.kv_counts",
+            ),
+            key_counts=widen_counts(
+                self._key[np.ix_(rows, np.arange(nkey))],
+                site="residency.key_counts",
+            ),
             total=self._total[rows],
             kv_id=self.kv_id,
             key_id=self.key_id,

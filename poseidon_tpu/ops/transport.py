@@ -55,6 +55,7 @@ import numpy as np
 from jax import lax
 
 from poseidon_tpu.utils.hatches import hatch_bool, hatch_int, hatch_raw
+from poseidon_tpu.utils.numerics import certify_i32_total
 from poseidon_tpu.utils.stagetimer import stage as _stage
 
 # Raw (cost-model) costs must fit in COST_CAP; admissibility masking uses
@@ -191,8 +192,11 @@ _TR_COLS = 3      # machine columns with positive excess
 _TR_EPS = 4       # the phase's epsilon rung
 _TR_GU = 5        # 1 when this iteration ran the BF global update
 _TR_BF = 6        # Bellman-Ford sweeps spent this iteration
-# row 7 reserved; per-shard active machine-side excess rows start at
-# TELEM_ROWS when the sharded wrapper requests them.
+_TR_SAT = 7       # 1 when the active-excess total SATURATED (the int32
+#                   sum would have wrapped; _active_excess_sat clamped
+#                   it to INT32_MAX and flagged it here instead)
+# Per-shard active machine-side excess rows start at TELEM_ROWS when
+# the sharded wrapper requests them.
 
 
 def solve_telemetry_cap() -> int:
@@ -235,7 +239,7 @@ def _telem_vals(it_global, exc_e, exc_m, exc_t, eps, fired, sweeps,
     split into per-shard sums (equal column blocks — the sharded
     wrapper lays the machine axis over the mesh in exactly these
     blocks), appended after the shared rows."""
-    tot = _active_excess(exc_e, exc_m, exc_t)
+    tot, sat = _active_excess_sat(exc_e, exc_m, exc_t)
     rows = jnp.sum((exc_e > 0).astype(jnp.int32))
     cols = jnp.sum((exc_m > 0).astype(jnp.int32))
     vals = [
@@ -243,11 +247,23 @@ def _telem_vals(it_global, exc_e, exc_m, exc_t, eps, fired, sweeps,
         jnp.asarray(eps, jnp.int32),
         fired.astype(jnp.int32),
         jnp.asarray(sweeps, jnp.int32),
-        jnp.int32(0),
+        # _TR_SAT: 1 when the active-excess lane clamped instead of
+        # wrapping — the host-side decode (and the cluster rung's
+        # saturation leg) read the overflow regime off this row.
+        sat.astype(jnp.int32),
     ]
     if telem_shards > 1:
-        shard = jnp.sum(
-            jnp.maximum(exc_m, 0).reshape(telem_shards, -1), axis=1
+        # Per-shard machine-side sums ride the same saturation clamp as
+        # the total (one shard can carry the whole cliff), keyed on the
+        # same float32 shadow-sum threshold.
+        pm = jnp.maximum(exc_m, 0)
+        shard_raw = jnp.sum(pm.reshape(telem_shards, -1), axis=1)
+        shard_shadow = jnp.sum(
+            pm.astype(jnp.float32).reshape(telem_shards, -1), axis=1
+        )
+        shard = jnp.where(
+            shard_shadow >= _EXCESS_SAT_THRESH,
+            jnp.int32(_EXCESS_SAT), shard_raw,
         )
         vals.extend(shard[i] for i in range(telem_shards))
     return vals
@@ -268,8 +284,12 @@ class SolveTelemetry:
     eps: np.ndarray            # epsilon rung of the sample's phase
     gu_fired: np.ndarray       # 1 where the BF global update ran
     bf_sweeps: np.ndarray      # BF sweeps spent that iteration
-    total_iters: int
-    cap: int
+    # 1 where the active-excess total SATURATED (clamped to INT32_MAX
+    # instead of wrapping; _TR_SAT) — a nonzero lane means the
+    # active_excess samples are lower bounds, not exact totals.
+    saturated: np.ndarray = None  # type: ignore[assignment]
+    total_iters: int = 0
+    cap: int = 0
     # Per-shard machine-side active excess [S, n] (mesh-sharded solves
     # only): the per-device work series the sharded tier's bench lanes
     # consume.
@@ -280,6 +300,13 @@ class SolveTelemetry:
 
     def gu_firings(self) -> int:
         return int(self.gu_fired.sum())
+
+    def saturated_samples(self) -> int:
+        """Samples whose active-excess total clamped at INT32_MAX
+        instead of wrapping (0 on rings decoded without the lane)."""
+        if self.saturated is None:
+            return 0
+        return int(self.saturated.sum())
 
     def wrapped(self) -> bool:
         return self.total_iters > self.samples()
@@ -325,6 +352,7 @@ class SolveTelemetry:
             "cap": int(self.cap),
             "wrapped": self.wrapped(),
             "gu_firings": self.gu_firings(),
+            "saturated_samples": self.saturated_samples(),
             "bf_sweeps": int(self.bf_sweeps.sum()),
             "decay_half_life": self.decay_half_life(),
             "iters_to_90": self.iters_to_drain(0.9),
@@ -370,6 +398,7 @@ def decode_telemetry(ring, total_iters: int,
         eps=ring[_TR_EPS, idx],
         gu_fired=ring[_TR_GU, idx],
         bf_sweeps=ring[_TR_BF, idx],
+        saturated=ring[_TR_SAT, idx],
         total_iters=total_iters,
         cap=cap,
         shard_excess=shard,
@@ -419,17 +448,50 @@ def _gu_fire(adaptive, it, next_gu, global_every):
     )
 
 
-def _active_excess(exc_e, exc_m, exc_t):
-    """Total ACTIVE (positive) excess — the adaptive cadence's progress
-    signal.  Shape-agnostic (the fused/tiled kernels carry 2-D excess
-    planes) and shared like _gu_fire/_gu_advance so the three
-    implementations cannot drift apart on it.  int32-safe: positive
-    excess is bounded by total supply, validated < 2^31."""
-    return (
-        jnp.sum(jnp.maximum(exc_e, 0))
-        + jnp.sum(jnp.maximum(exc_m, 0))
-        + jnp.maximum(exc_t, 0)
+# Saturation rail for the active-excess telemetry lane.  The float32
+# shadow sum that drives the clamp decision carries worst-case relative
+# error well under 2x even for sequential reduction order, so the
+# threshold sits at HALF the int32 range: any true sum >= 2^31 (a wrap)
+# lands above it, and any true sum below 2^30 is returned bit-exactly
+# by the int32 sum — the historical behavior at every real scale.
+# Totals between 2^30 and 2^31 may clamp conservatively; the point is
+# that NO total ever wraps silently (_TR_SAT carries the flag).
+_EXCESS_SAT = (1 << 31) - 1
+_EXCESS_SAT_THRESH = float(1 << 30)
+
+
+def _active_excess_sat(exc_e, exc_m, exc_t):
+    """Total ACTIVE (positive) excess plus its saturation flag — the
+    adaptive cadence's progress signal.  Shape-agnostic (the fused/
+    tiled kernels carry 2-D excess planes) and shared like _gu_fire/
+    _gu_advance so the kernel implementations cannot drift apart on it.
+
+    Each element is < 2^31, but the cluster-scale SUM can exceed int32
+    (slot capacities and EC counts driven toward the cliff) and would
+    wrap silently in XLA.  A float32 shadow sum detects the overflow
+    regime and the int32 total clamps to INT32_MAX with ``sat`` set —
+    below the threshold the int32 sum is exact and returned unchanged,
+    so small-scale solves (and the adaptive-BF cadence they drive) stay
+    bit-identical to the unclamped code.  A saturated total also never
+    looks "decayed" to _gu_advance (INT32_MAX <= INT32_MAX // 2 is
+    false), so the cadence stays at its conservative base while
+    saturated.  Pure sums/where — Mosaic-safe for the fused kernel."""
+    pe = jnp.maximum(exc_e, 0)
+    pm = jnp.maximum(exc_m, 0)
+    pt = jnp.maximum(exc_t, 0)
+    raw = jnp.sum(pe) + jnp.sum(pm) + pt
+    shadow = (
+        jnp.sum(pe.astype(jnp.float32))
+        + jnp.sum(pm.astype(jnp.float32))
+        + pt.astype(jnp.float32)
     )
+    sat = shadow >= _EXCESS_SAT_THRESH
+    return jnp.where(sat, jnp.int32(_EXCESS_SAT), raw), sat
+
+
+def _active_excess(exc_e, exc_m, exc_t):
+    """The saturating total alone (see _active_excess_sat)."""
+    return _active_excess_sat(exc_e, exc_m, exc_t)[0]
 
 
 def _gu_advance(fired, tot_excess, it, next_gu, gap, last_exc,
@@ -939,7 +1001,9 @@ def _solve_device(costs, supply, capacity, unsched_cost, arc_cap, init_prices,
     U = (unsched_cost * scale).astype(jnp.int32)
     supply = supply.astype(jnp.int32)
     cap = capacity.astype(jnp.int32)
-    total = jnp.sum(supply)
+    # int32 sum is certified at the host boundary: solve_transport's
+    # certify_i32_total(supply) bounds it inside the int32 rails.
+    total = jnp.sum(supply)  # posecheck: ignore[numerics]
     # Arc capacity min(s_e, c_m, fit): the supply/column clamp never binds
     # an optimal solution but keeps saturation-induced deficits small; the
     # fit bound is a real constraint from the cost model.
@@ -1026,10 +1090,20 @@ def _fetch_with_retry(dev_array, attempts: int = 3) -> np.ndarray:
     boundary (posecheck transfer-discipline), and explicit transfers
     stay legal inside a ``TransferLedger``/``jax.transfer_guard``
     budget-0 window while implicit ones fail it.
+
+    Being THE boundary also makes it the NumericsLedger's validation
+    point: with POSEIDON_NUMERICS_LEDGER on or a ledger window open,
+    every fetched leaf is checked for finiteness and declared int32
+    headroom (check/ledger.maybe_validate_fetched) — anomalies are
+    counted, attributed to open windows, and never raised here.
     """
+    from poseidon_tpu.check.ledger import maybe_validate_fetched
+
     for attempt in range(attempts):
         try:
-            return jax.device_get(dev_array)
+            out = jax.device_get(dev_array)
+            maybe_validate_fetched(out, site="host_fetch")
+            return out
         except Exception as e:  # noqa: BLE001
             if attempt == attempts - 1 or not _is_transient_backend_error(e):
                 raise
@@ -1594,7 +1668,9 @@ def _coarse_aggregate(costs, capacity, arc_capacity, gid, groups):
     csum = np.where(adm, costs.astype(np.float64), 0.0) @ onehot
     Cg = np.full((E, groups), INF_COST, dtype=np.int32)
     has = n_adm > 0
-    Cg[has] = np.round(csum[has] / n_adm[has]).astype(np.int32)
+    # Bounded: a mean of admissible costs never exceeds the max cost,
+    # and every admissible cost is < INF_COST = 2^28 — far inside i32.
+    Cg[has] = np.round(csum[has] / n_adm[has]).astype(np.int32)  # posecheck: ignore[numerics]
     capg = capacity.astype(np.float64) @ onehot
     capg = np.minimum(capg, np.iinfo(np.int32).max // 4).astype(np.int32)
     arcg = np.minimum(arc64.astype(np.float64) @ onehot,
@@ -2362,6 +2438,11 @@ def solve_transport(
     supply = np.asarray(supply, dtype=np.int32)
     capacity = np.asarray(capacity, dtype=np.int32)
     unsched_cost = np.asarray(unsched_cost, dtype=np.int32)
+    # In-kernel reductions over flows/supplies accumulate in int32 (x64
+    # is disabled on device); flow conservation bounds every such sum by
+    # the total supply, so this single host-boundary certificate covers
+    # them all (the kernel-side sums carry ignore[numerics] citing it).
+    certify_i32_total(supply, site="solve_transport.supply")
     E, M = costs.shape
     if E == 0 or M == 0:
         # Degenerate rounds (idle cluster / no machines yet): everything that

@@ -126,7 +126,9 @@ def _greedy_seed_device(C, supply, capacity, arc_cap, unsched, scale,
         row, capacity.astype(jnp.int32), (supply, arc_cap, adm, order, inv)
     )
     F0 = F0.astype(jnp.int32)
-    leftover = supply - F0.sum(axis=1)
+    # Flow conservation: row sums are bounded by the total supply, which
+    # solve_transport's certify_i32_total certified inside int32.
+    leftover = supply - F0.sum(axis=1)  # posecheck: ignore[numerics]
     fb0 = leftover.astype(jnp.int32)
 
     # Equilibrium duals (the host alternation, int32: scaled costs and
@@ -154,7 +156,8 @@ def _greedy_seed_device(C, supply, capacity, arc_cap, unsched, scale,
     cap_p = PRICE_SPREAD_CAP - 1
     pm0 = jnp.clip(pm0, -cap_p, cap_p)
     pe0 = jnp.clip(pe0, -cap_p, cap_p)
-    spare = F0.sum(axis=0) < capacity
+    # Column sums bounded by the certified total supply (see above).
+    spare = F0.sum(axis=0) < capacity  # posecheck: ignore[numerics]
     pt0 = jnp.where(spare, pm0, BIG).min()
     pt0 = jnp.where(pt0 == BIG, 0, jnp.minimum(pt0, 0))
     prices = jnp.concatenate(

@@ -29,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from poseidon_tpu.ops import transport
 from poseidon_tpu.utils.hatches import hatch_bool
+from poseidon_tpu.utils.numerics import certify_i32_total
 from poseidon_tpu.ops.transport import (
     INF_COST,
     TransportSolution,
@@ -116,6 +117,9 @@ def solve_transport_sharded(
     supply = np.asarray(supply, dtype=np.int32)
     capacity = np.asarray(capacity, dtype=np.int32)
     unsched_cost = np.asarray(unsched_cost, dtype=np.int32)
+    # Same host-boundary certificate as solve_transport: in-kernel int32
+    # flow sums (incl. the per-shard partials) are bounded by this total.
+    certify_i32_total(supply, site="solve_transport_sharded.supply")
     E, M = costs.shape
     n_dev = int(np.prod(list(mesh.shape.values())))
     if E == 0 or M == 0 or n_dev <= 1:

@@ -205,6 +205,16 @@ HATCHES: Tuple[Hatch, ...] = (
     Hatch("POSEIDON_RACE_SWEEP", "int", "3",
           "Seeded interleavings each race-harness suite drives (CI "
           "default 3; soak boxes can turn it up)"),
+    # --------------------------------------------------------------- numerics
+    Hatch("POSEIDON_NUMERICS_LEDGER", "bool_off", "0",
+          "Validate every host_fetch result against the numerics "
+          "contract (finite floats, int32 values clear of the rails); "
+          "anomalies feed RoundMetrics.numeric_anomalies and any open "
+          "check.ledger.NumericsLedger window"),
+    Hatch("POSEIDON_NUMERICS_SCOPES", "str", "",
+          "Comma-separated path fragments overriding the posecheck "
+          "`numerics` rule's default scope (poseidon_tpu/ops/, "
+          "poseidon_tpu/costmodel/, poseidon_tpu/graph/)"),
     # ------------------------------------------------------- process plumbing
     Hatch("POSEIDON_COMPILE_CACHE_DIR", "str", "",
           "Persistent XLA compile cache directory for "

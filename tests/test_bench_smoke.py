@@ -252,3 +252,21 @@ def test_strided_shards_flatten_lopsided_lanes(monkeypatch):
         f"strided {strided.shard_imbalance} !< "
         f"contiguous {contig.shard_imbalance}"
     )
+
+
+def test_saturation_probe_never_wraps_silently():
+    """The cluster rung's saturation leg at its native tiny scale:
+    supplies past the int32 cliff are REFUSED by the host-boundary
+    flow-sum certificate, and a dispatchable at-the-cliff instance
+    comes back with the telemetry saturation lane clamped+flagged and
+    the rail-riding fetch attributed to the open NumericsLedger —
+    never a silent two's-complement wrap."""
+    import bench
+
+    out = bench.run_saturation_probe()
+    assert out["ok"], out
+    assert out["certificate_tripped"]
+    assert out["saturated_samples"] > 0
+    assert out["ledger_anomalies"] > 0
+    assert not out["wrap_observed"]
+    assert out["max_active_excess"] > 0  # clamped at the rail, not -2^31

@@ -1,0 +1,414 @@
+"""Numerics-discipline suite: runtime ledger + certified helpers + the
+static rule's integration seams.
+
+The static rule's fixture counts live in test_check_selfcheck.py; this
+file pins the RUNTIME half and the places the two halves meet:
+
+- utils.numerics saturation certificates (widen/narrow/total/headroom)
+  raise ``SaturationError`` naming the site and feed the process-wide
+  anomaly counter — never a silent wrap;
+- ``NumericsLedger(budget=0)`` window semantics: attribution, offender
+  naming, telemetry mode, exception transparency;
+- a seeded overflow trips the static rule AND the runtime ledger (the
+  same hazard, caught by both halves);
+- the inf-sentinel lattice follows a plane through a jitted producer;
+- a promotion hazard at a jit boundary shaped like the real ops/
+  wrappers is flagged;
+- ``transport.host_fetch`` validates fetched leaves only when enabled,
+  and a real solve is ledger-clean under ``POSEIDON_NUMERICS_LEDGER``;
+- regression pins for the audited real findings: the cpu_mem fit-count
+  clamp, the residency int64 certified view, the telemetry ring's
+  saturating active-excess lane (satellite bugfix) and its decode;
+- ``RoundMetrics.numeric_anomalies`` rides the wire format and the
+  Prometheus exporter without touching either.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from poseidon_tpu.check import check_file
+from poseidon_tpu.check.ledger import (
+    I32_FETCH_HEADROOM,
+    NumericsBudgetExceeded,
+    NumericsLedger,
+    maybe_validate_fetched,
+    note_numeric_anomaly,
+    numeric_anomaly_count,
+    numerics_enabled,
+)
+from poseidon_tpu.check.numerics_discipline import NumericsDisciplineRule
+from poseidon_tpu.utils.numerics import (
+    COUNT_HEADROOM,
+    I32_MAX,
+    I32_MIN,
+    SaturationError,
+    certify_i32,
+    certify_i32_total,
+    checked_narrow_i32,
+    i32_headroom,
+    widen_counts,
+)
+
+REPO = Path(__file__).parent.parent
+
+
+def _rule_findings(path: Path, root: Path):
+    """check() + finalize() — the numerics rule judges sentinel flow
+    and jit-boundary literals in finalize()."""
+    rule = NumericsDisciplineRule()
+    pre = check_file(path, [rule], forced=True, root=root)
+    return pre + rule.finalize()
+
+
+# ----------------------------------------------------- certified helpers
+
+
+def test_certify_i32_passes_inside_band():
+    a = np.array([0, 5, -5, COUNT_HEADROOM - 1], dtype=np.int32)
+    assert certify_i32(a, site="t") is a          # zero-copy certificate
+    assert certify_i32(np.empty(0, np.int32), site="t").size == 0
+
+
+def test_certify_i32_trips_and_counts():
+    a = np.array([0, I32_MAX - 3], dtype=np.int32)
+    c0 = numeric_anomaly_count()
+    with pytest.raises(SaturationError, match="test.site"):
+        certify_i32(a, site="test.site")
+    assert numeric_anomaly_count() == c0 + 1
+
+
+def test_widen_counts_certifies_then_widens():
+    a = np.array([[1, 2], [3, 4]], dtype=np.int32)
+    w = widen_counts(a, site="t")
+    assert w.dtype == np.int64
+    assert (w == a).all()
+    with pytest.raises(SaturationError):
+        widen_counts(
+            np.array([I32_MAX - 1], dtype=np.int32), site="t"
+        )
+
+
+def test_certify_i32_total_bounds_the_sum():
+    a = np.full(8, 1000, dtype=np.int32)
+    assert certify_i32_total(a, site="t") == 8000
+    assert certify_i32_total(np.empty(0, np.int32), site="t") == 0
+    # Each element fits int32; the SUM does not — the in-kernel flow
+    # reductions this certificate covers would wrap.
+    hot = np.full(4, 1 << 30, dtype=np.int32)
+    with pytest.raises(SaturationError, match="flow sums would wrap"):
+        certify_i32_total(hot, site="t")
+
+
+def test_checked_narrow_clamps_or_raises():
+    wide = np.array([-5.0, 10.0, 3e10], dtype=np.float64)
+    out = checked_narrow_i32(wide, site="t", lo=0, hi=1 << 20)
+    assert out.dtype == np.int32
+    assert out.tolist() == [0, 10, 1 << 20]
+    with pytest.raises(SaturationError, match="not declared legal"):
+        checked_narrow_i32(wide, site="t", lo=0, hi=1 << 20, clamp=False)
+    with pytest.raises(ValueError):
+        checked_narrow_i32(wide, site="t", lo=0, hi=1 << 40)
+
+
+def test_i32_headroom():
+    assert i32_headroom(np.empty(0, np.int32)) is None
+    a = np.array([I32_MAX - 7, 0], dtype=np.int32)
+    assert i32_headroom(a) == 7
+
+
+# ------------------------------------------------------- ledger windows
+
+
+def test_ledger_clean_window_passes():
+    c0 = numeric_anomaly_count()
+    with NumericsLedger(budget=0, label="clean") as led:
+        pass
+    assert led.anomalies == 0
+    assert numeric_anomaly_count() == c0
+
+
+def test_ledger_budget_zero_trips_with_offender_name():
+    with pytest.raises(NumericsBudgetExceeded, match="seeded.wrap"):
+        with NumericsLedger(budget=0, label="unit window"):
+            note_numeric_anomaly("seeded.wrap: fixture anomaly")
+
+
+def test_ledger_telemetry_mode_records_without_raising():
+    with NumericsLedger(budget=None, label="telemetry") as led:
+        note_numeric_anomaly("t1")
+        note_numeric_anomaly("t2")
+    assert led.anomalies == 2
+    assert led.offenders == ["t1", "t2"]
+
+
+def test_ledger_does_not_mask_body_exceptions():
+    with pytest.raises(KeyError):
+        with NumericsLedger(budget=0):
+            note_numeric_anomaly("anomaly before the crash")
+            raise KeyError("primary failure")
+
+
+# ------------------------------------- the static rule meets the runtime
+
+
+def test_seeded_overflow_trips_static_rule_and_ledger(tmp_path):
+    """ONE hazard, both halves: an unwidened i32 reduction is a static
+    finding, and executing the equivalent accumulation through the
+    certified helper trips a budget-0 ledger window at runtime."""
+    mod = tmp_path / "counts.py"
+    mod.write_text(
+        "import numpy as np\n\n\n"
+        "def tally():\n"
+        "    counts = np.zeros((4, 4), dtype=np.int32)\n"
+        "    return np.sum(counts)\n"
+    )
+    found = _rule_findings(mod, tmp_path)
+    assert len(found) == 1
+    assert found[0].rule == "numerics"
+    assert found[0].message.startswith("i32-overflow:")
+
+    hot = np.full(4, I32_MAX - 2, dtype=np.int32)
+    c0 = numeric_anomaly_count()
+    with pytest.raises(NumericsBudgetExceeded, match="test.seeded"):
+        with NumericsLedger(budget=0, label="seeded overflow"):
+            try:
+                widen_counts(hot, site="test.seeded")
+            except SaturationError:
+                pass  # certificate fired; the window still owes budget 0
+    assert numeric_anomaly_count() == c0 + 1
+
+
+def test_sentinel_lattice_through_jitted_producer(tmp_path):
+    """The inf-sentinel lattice is cross-function: a plane seeded inside
+    a jitted producer taints the CALLER's arithmetic on the result."""
+    mod = tmp_path / "plane.py"
+    mod.write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n\n"
+        "INF_COST = 1 << 28\n\n\n"
+        "@jax.jit\n"
+        "def _plane(c):\n"
+        "    p = jnp.where(c > 3, INF_COST, c)\n"
+        "    return p\n\n\n"
+        "def consume(c):\n"
+        "    out = _plane(c)\n"
+        "    return np.sum(out)\n"
+    )
+    found = _rule_findings(mod, tmp_path)
+    assert len(found) == 1
+    assert found[0].message.startswith("inf-sentinel:")
+    assert "sum" in found[0].message
+
+
+def test_promotion_at_ops_shaped_jit_boundary(tmp_path):
+    """The promotion sub-rule at the seam the real ops/ wrappers have:
+    a jitted kernel taking a scale argument, called with a bare Python
+    float — a weak scalar whose promotion XLA decides, not the author."""
+    mod = tmp_path / "kern.py"
+    mod.write_text(
+        "import jax\n\n\n"
+        "@jax.jit\n"
+        "def kern(x, s):\n"
+        "    return x * s\n\n\n"
+        "def boundary(x):\n"
+        "    return kern(x, 0.5)\n"
+    )
+    found = _rule_findings(mod, tmp_path)
+    assert len(found) == 1
+    assert found[0].message.startswith("promotion:")
+    assert found[0].line == 10  # the call site, not the kernel
+
+
+# -------------------------------------------------- host_fetch boundary
+
+
+def test_validation_off_by_default(monkeypatch):
+    monkeypatch.delenv("POSEIDON_NUMERICS_LEDGER", raising=False)
+    assert not numerics_enabled()
+    c0 = numeric_anomaly_count()
+    maybe_validate_fetched(np.array([np.inf], dtype=np.float32))
+    assert numeric_anomaly_count() == c0  # one dict probe, no scan
+
+
+def test_fetch_validation_flags_nonfinite_and_rails():
+    c0 = numeric_anomaly_count()
+    with NumericsLedger(budget=None, label="fetch") as led:
+        maybe_validate_fetched(
+            {"a": np.array([1.0, np.inf], dtype=np.float32)},
+            site="unit.fetch",
+        )
+        maybe_validate_fetched(
+            np.array([I32_MAX - 5], dtype=np.int32), site="unit.rails"
+        )
+        # Clean leaves cost nothing: finite floats, int32 with headroom,
+        # non-array leaves.
+        maybe_validate_fetched(
+            (np.zeros(3, np.float32),
+             np.array([I32_MAX - I32_FETCH_HEADROOM], dtype=np.int32),
+             7, "label"),
+            site="unit.clean",
+        )
+    assert numeric_anomaly_count() == c0 + 2
+    assert led.anomalies == 2
+    assert any("unit.fetch" in o and "non-finite" in o
+               for o in led.offenders)
+    assert any("unit.rails" in o and "rails" in o for o in led.offenders)
+
+
+def test_real_solve_is_ledger_clean(monkeypatch):
+    """The acceptance shape: a real ops/ solve inside a budget-0 window
+    with the hatch on — every host_fetch leaf validated, zero
+    anomalies."""
+    from poseidon_tpu.ops.transport import INF_COST, solve_transport
+
+    monkeypatch.setenv("POSEIDON_NUMERICS_LEDGER", "1")
+    assert numerics_enabled()
+    rng = np.random.default_rng(7)
+    costs = rng.integers(0, 1000, size=(6, 5)).astype(np.int32)
+    costs[rng.random((6, 5)) < 0.1] = INF_COST
+    supply = rng.integers(1, 4, size=6).astype(np.int32)
+    capacity = rng.integers(1, 5, size=5).astype(np.int32)
+    unsched = rng.integers(1000, 2000, size=6).astype(np.int32)
+    with NumericsLedger(budget=0, label="real solve") as led:
+        sol = solve_transport(costs, supply, capacity, unsched)
+    assert sol.flows.shape == (6, 5)
+    assert led.anomalies == 0
+
+
+def test_solve_transport_certifies_supply_total():
+    """The host-boundary flow-sum certificate: a supply vector whose
+    TOTAL would wrap the in-kernel int32 reductions is rejected at
+    dispatch, never solved silently."""
+    from poseidon_tpu.ops.transport import INF_COST, solve_transport
+
+    E, M = 4, 3
+    costs = np.full((E, M), 10, dtype=np.int32)
+    supply = np.full(E, 1 << 30, dtype=np.int32)   # sum = 2^32: wraps
+    capacity = np.full(M, 2, dtype=np.int32)
+    unsched = np.full(E, 100, dtype=np.int32)
+    with pytest.raises(SaturationError, match="solve_transport.supply"):
+        solve_transport(costs, supply, capacity, unsched)
+
+
+# ------------------------------------------------- audited-finding pins
+
+
+def test_cpu_mem_fit_count_clamps_not_wraps():
+    """PR 2's bug class, re-audited this PR: a huge-free/tiny-request
+    fit count past 2^31 must clamp at big_fit, not wrap negative
+    through astype(int32).  Covers the finite-overflow cell, the
+    zero-request inf cell, and a normal cell."""
+    from poseidon_tpu.costmodel.base import ECTable, MachineTable
+    from poseidon_tpu.costmodel.cpu_mem import CpuMemCostModel
+
+    big_fit = np.iinfo(np.int32).max // 4
+    ecs = ECTable(
+        ec_ids=np.arange(2, dtype=np.uint64),
+        cpu_request=np.array([1, 0], dtype=np.int64),
+        ram_request=np.array([1, 1], dtype=np.int64),
+        supply=np.ones(2, dtype=np.int32),
+        priority=np.zeros(2, dtype=np.int32),
+        task_type=np.zeros(2, dtype=np.int32),
+        max_wait_rounds=np.zeros(2, dtype=np.int32),
+        selectors=[(), ()],
+    )
+    machines = MachineTable(
+        uuids=["m0", "m1"],
+        cpu_capacity=np.array([3 << 30, 64], dtype=np.int64),
+        ram_capacity=np.array([3 << 30, 64], dtype=np.int64),
+        cpu_used=np.zeros(2, dtype=np.int64),
+        ram_used=np.zeros(2, dtype=np.int64),
+        cpu_util=np.zeros(2, dtype=np.float32),
+        mem_util=np.zeros(2, dtype=np.float32),
+        slots_free=np.full(2, 10, dtype=np.int32),
+        labels=[{}, {}],
+    )
+    mats = CpuMemCostModel().build(ecs, machines)
+    assert (mats.arc_capacity >= 0).all()          # no wrap anywhere
+    # EC0 x m0: 3*2^30 fits of size 1 — finite, past int32, clamped.
+    assert mats.arc_capacity[0, 0] == big_fit
+    # EC1 (zero cpu request) x m0: inf fit count, clamped the same way.
+    assert mats.arc_capacity[1, 0] == big_fit
+    # Normal cell stays exact.
+    assert mats.arc_capacity[0, 1] == 64
+
+
+def test_residency_view_is_certified_int64():
+    from poseidon_tpu.graph.residency import ResidentLabelIndex
+
+    idx = ResidentLabelIndex()
+    idx.activate()
+    idx.add("m0", {"app": "db"})
+    idx.add("m0", {"app": "db"})
+    idx.add("m1", {"app": "web"})
+    view = idx.view(["m0", "m1"])
+    assert view.kv_counts.dtype == np.int64
+    assert view.key_counts.dtype == np.int64
+    assert view.kv_counts[0, view.kv_id[("app", "db")]] == 2
+    assert view.kv_counts[1, view.kv_id[("app", "web")]] == 1
+
+
+# ------------------------------------- telemetry saturation (satellite)
+
+
+def test_active_excess_exact_below_threshold():
+    from poseidon_tpu.ops.transport import _active_excess_sat
+
+    exc_e = jnp.array([100, -50, 200], dtype=jnp.int32)
+    exc_m = jnp.array([[5, 0], [-3, 500]], dtype=jnp.int32)
+    tot, sat = _active_excess_sat(exc_e, exc_m, jnp.int32(0))
+    assert int(tot) == 100 + 200 + 5 + 500        # bit-exact, shapes mix
+    assert not bool(sat)
+
+
+def test_active_excess_saturates_at_cluster_scale():
+    from poseidon_tpu.ops.transport import _EXCESS_SAT, _active_excess_sat
+
+    # Each element far below int32; the SUM is past 2^31 and the bare
+    # int32 reduction XLA runs would wrap it negative.
+    exc_e = jnp.full(5, 1 << 29, dtype=jnp.int32)
+    tot, sat = _active_excess_sat(
+        exc_e, jnp.zeros(1, jnp.int32), jnp.int32(0)
+    )
+    assert bool(sat)
+    assert int(tot) == _EXCESS_SAT                 # clamped, flagged
+    assert int(tot) > 0                            # never negative
+
+
+def test_decode_telemetry_carries_saturation_lane():
+    from poseidon_tpu.ops.transport import (
+        TELEM_ROWS,
+        _TR_SAT,
+        decode_telemetry,
+    )
+
+    ring = np.zeros((TELEM_ROWS, 4), dtype=np.int32)
+    ring[_TR_SAT, :] = [0, 1, 1, 0]
+    t = decode_telemetry(ring, 4)
+    assert t.saturated.tolist() == [0, 1, 1, 0]
+    assert t.saturated_samples() == 2
+    assert t.digest()["saturated_samples"] == 2
+
+
+# ----------------------------------------------------- metrics plumbing
+
+
+def test_numeric_anomalies_rides_wire_format_and_metrics():
+    from poseidon_tpu.graph.instance import RoundMetrics
+    from poseidon_tpu.obs.metrics import Registry, observe_round
+
+    m = RoundMetrics(round_index=4, numeric_anomalies=3)
+    d = m.to_dict()
+    assert d["numeric_anomalies"] == 3
+    back = RoundMetrics.from_dict(d)
+    assert back.numeric_anomalies == 3
+
+    reg = Registry()
+    observe_round(m, reg)
+    assert "poseidon_round_numeric_anomalies 3" in reg.expose()

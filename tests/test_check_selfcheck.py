@@ -370,6 +370,58 @@ def test_dispatch_budget_never_judges_file_list_scans():
     assert run([str(REPO / "poseidon_tpu")], root=REPO) == []
 
 
+# ------------------------------------------------------------ numerics
+
+
+def test_numerics_clean_fixture():
+    from poseidon_tpu.check.numerics_discipline import (
+        NumericsDisciplineRule,
+    )
+
+    assert _project_findings(
+        NumericsDisciplineRule(), "numerics_clean.py"
+    ) == []
+
+
+def test_numerics_violations():
+    from poseidon_tpu.check.numerics_discipline import (
+        NumericsDisciplineRule,
+    )
+
+    found = _project_findings(
+        NumericsDisciplineRule(), "numerics_violations.py"
+    )
+    msgs = [f.message for f in found]
+    assert len(found) == 12
+    assert sum(m.startswith("i32-overflow:") for m in msgs) == 5
+    assert sum(m.startswith("inf-sentinel:") for m in msgs) == 4
+    assert sum(m.startswith("promotion:") for m in msgs) == 3
+    assert sum("narrowing" in m for m in msgs) == 2
+    assert sum("weak" in m for m in msgs) == 3
+    # The two seeded `ignore[numerics]` hazards did not count (one on
+    # the per-file overflow path, one on the finalize sentinel path).
+    assert all(f.rule == "numerics" for f in found)
+
+
+def test_numerics_scope(monkeypatch):
+    from poseidon_tpu.check.numerics_discipline import (
+        NumericsDisciplineRule,
+    )
+
+    rule = NumericsDisciplineRule()
+    assert rule.applies_to("poseidon_tpu/ops/transport.py")
+    assert rule.applies_to("poseidon_tpu/costmodel/cpu_mem.py")
+    assert rule.applies_to("poseidon_tpu/graph/residency.py")
+    assert not rule.applies_to("poseidon_tpu/glue/poseidon.py")
+    # POSEIDON_NUMERICS_SCOPES narrows (or widens) the walk.
+    monkeypatch.setenv(
+        "POSEIDON_NUMERICS_SCOPES", "poseidon_tpu/glue/"
+    )
+    narrowed = NumericsDisciplineRule()
+    assert narrowed.applies_to("poseidon_tpu/glue/poseidon.py")
+    assert not narrowed.applies_to("poseidon_tpu/ops/transport.py")
+
+
 # ----------------------------------------------------- concurrency rules
 
 

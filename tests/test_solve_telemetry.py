@@ -219,6 +219,38 @@ def test_ring_bit_identical_across_kernels():
     assert t is not None and t.samples() == min(int(lax_out[3]), 256)
 
 
+def test_saturation_lane_decodes_identically_across_kernels():
+    """The _TR_SAT lane (PR 19) rides the shared ring: every kernel
+    must emit the same saturation flags, and at toy scale — where the
+    active-excess total sits far below the 2^30 clamp threshold — the
+    lane must decode to all-zero (no false positives)."""
+    from poseidon_tpu.ops.transport_fused import solve_device_fused
+    from poseidon_tpu.ops.transport_tiled import solve_device_tiled
+
+    costs, supply, capacity, unsched = _instance(5, 16, 128, cap_hi=2)
+    args, scale = _device_args(costs, supply, capacity, unsched)
+    lax_out = _solve_device(*args, max_iter=8192, scale=scale,
+                            telem_cap=256)
+    fused_out = solve_device_fused(*args, max_iter=8192, scale=scale,
+                                   interpret=True, telem_cap=256)
+    tiled_out = solve_device_tiled(*args, max_iter=8192, scale=scale,
+                                   interpret=True, telem_cap=256)
+    decoded = [
+        decode_telemetry(np.asarray(out[7]), int(out[3]))
+        for out in (lax_out, fused_out, tiled_out)
+    ]
+    base = decoded[0]
+    assert base is not None and base.saturated is not None
+    for t in decoded[1:]:
+        assert t is not None
+        np.testing.assert_array_equal(base.saturated, t.saturated)
+        assert t.saturated_samples() == base.saturated_samples()
+    # Toy instances never approach the clamp threshold: a nonzero lane
+    # here would mean the flag fires spuriously on healthy solves.
+    assert base.saturated_samples() == 0
+    assert all(t.digest()["saturated_samples"] == 0 for t in decoded)
+
+
 # ----------------------------------------------------------- sharded lanes
 
 
