@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: all test test-fast bench protos native verify lint lint-fast \
   bench-smoke soak-smoke trace-smoke profile-smoke throughput-smoke \
-  perf-gate demo demo-stop clean
+  scenario-smoke perf-gate demo demo-stop clean
 
 all: protos native lint test
 
@@ -60,6 +60,16 @@ profile-smoke:
 # synchronous kube truth byte-identical, warm windows compile-free.
 throughput-smoke:
 	$(PY) -m pytest tests/test_throughput_smoke.py -q -m slow -p no:cacheprovider
+
+# Scenario-harness smoke (docs/SCENARIOS.md): a tiny two-scenario plan
+# through the full glue+service stack in BOTH loop modes with every
+# gate armed — sync/streaming drain-equivalence (identical placement
+# and delta digests), seeded determinism, robustness scoring under
+# chaos-seeded cost perturbation, and the flight-recorder redrive of a
+# deliberately failed round.  Failure traces land under out/scenario/
+# (cleaned by `make clean`).
+scenario-smoke:
+	$(PY) -m pytest tests/test_scenario_smoke.py -q -m slow -p no:cacheprovider
 
 # Perf-regression gate (tools/bench_compare.py): diff a fresh bench
 # artifact's timing series (headline p50s + per-stage features timings)
@@ -151,7 +161,7 @@ lint-fast:
 # past the band fails verify.  POSEIDON_PERF_GATE=warn downgrades to
 # warn-only on known-noisy machines.
 verify: lint bench-smoke soak-smoke trace-smoke profile-smoke \
-  throughput-smoke perf-gate
+  throughput-smoke scenario-smoke perf-gate
 	$(PY) __graft_entry__.py
 
 # Backgrounded demo loop with its PID on record (out/demo.pid), so the
@@ -175,7 +185,7 @@ demo-stop:
 
 clean: demo-stop
 	rm -f poseidon_tpu/native/_graphcore.so
-	rm -rf out/soak
+	rm -rf out/soak out/scenario
 	rm -f out/trace_smoke.json out/trace_smoke_conv.json
 	rm -f out/trace_features.json out/bench_gate.jsonl
 	rm -f out/posecheck.json out/profile_smoke.json

@@ -1159,6 +1159,103 @@ def run_throughput(machines: int, seconds: float, seed: int) -> dict:
     return out
 
 
+def run_scenario(machines: int, rounds: int, seed: int) -> dict:
+    """Scenario rung (``--child scenario``): every named production-
+    shaped scenario (poseidon_tpu/scenario) through the FULL glue+
+    service stack, each one
+
+    - driven in BOTH loop modes with all gates armed (byte-identity,
+      budget-0 warm ledgers, tier vocabulary) and checked drain-
+      equivalent (identical per-round placement AND delta digests), and
+    - scored for robustness under chaos-seeded cost perturbation
+      (objective-regression quantiles across POSEIDON_SCENARIO_SEEDS
+      perturbed re-drives; scenario/score.py defines the metric).
+
+    Like the throughput rung this is a BEHAVIOR claim, not a scale
+    claim — it never pays ladder-sized machine counts.  The result
+    carries ``mode: "streaming"`` (the identity legs drive both modes),
+    so tools/bench_compare.py applies its mode guard."""
+    from poseidon_tpu.obs.metrics import observe_scenario
+    from poseidon_tpu.scenario import (
+        SCENARIOS,
+        drive_scenario,
+        named_scenario,
+        score_scenario,
+    )
+
+    scenarios = {}
+    ok = True
+    for name in SCENARIOS:
+        plan = named_scenario(
+            name, machines=machines, rounds=rounds, seed=seed
+        )
+        sync = drive_scenario(plan, streaming=False)
+        stream = drive_scenario(plan, streaming=True)
+        identity_ok = bool(
+            sync.get("ok") and stream.get("ok")
+            and sync.get("digests") == stream.get("digests")
+            and sync.get("delta_digests") == stream.get("delta_digests")
+        )
+        score = score_scenario(plan, baseline=sync)
+        entry = {
+            "ok": bool(identity_ok and score.get("ok")),
+            "identity_ok": identity_ok,
+            "rounds": sync.get("rounds_run"),
+            "scenario_digest": sync.get("scenario_digest"),
+            "placements_per_sec": stream.get("placements_per_sec", 0.0),
+            "placements_per_sec_sync": sync.get(
+                "placements_per_sec", 0.0
+            ),
+            "robustness_score": score.get("robustness_score", 0.0),
+            "regression_p90": score.get("regression_p90", 0.0),
+            "placement_divergence": score.get(
+                "placement_divergence", 0.0
+            ),
+            "admission_staleness_p50_s": sync.get(
+                "admission_staleness_p50_s", 0.0
+            ),
+            "admission_staleness_p99_s": sync.get(
+                "admission_staleness_p99_s", 0.0
+            ),
+            "objective": sync.get("objective", 0),
+            "solve_tiers": sorted(set(sync.get("tiers") or [])),
+        }
+        if not identity_ok:
+            entry["error"] = (
+                "streaming/synchronous scenario drives diverged: "
+                f"sync={sync.get('failure')} "
+                f"stream={stream.get('failure')}"
+            )
+        elif not score.get("ok"):
+            entry["error"] = f"perturbed gates: {score.get('failures')}"
+        observe_scenario(
+            name,
+            robustness_score=entry["robustness_score"],
+            placements_per_sec=entry["placements_per_sec"],
+            regression_p90=entry["regression_p90"],
+            placement_divergence=entry["placement_divergence"],
+            admission_staleness_p50_s=entry["admission_staleness_p50_s"],
+            admission_staleness_p99_s=entry["admission_staleness_p99_s"],
+            ok=entry["ok"],
+        )
+        scenarios[name] = entry
+        ok = ok and entry["ok"]
+        # A stage line per scenario: a timed-out child still posts the
+        # scenarios it finished (the parent salvages the last line).
+        print(json.dumps({
+            "ok": False, "partial": True, "mode": "streaming",
+            "machines": machines, "rounds": rounds,
+            "scenarios": dict(scenarios),
+        }), flush=True)
+    return {
+        "ok": ok,
+        "mode": "streaming",
+        "machines": machines,
+        "rounds": rounds,
+        "scenarios": scenarios,
+    }
+
+
 def run_parity() -> dict:
     """BASELINE config 1 (100 nodes / 1k pods): TPU solver objective must
     equal the exact host oracle on the same transportation instance."""
@@ -1447,7 +1544,7 @@ def run_cluster_rung(machines: int, tasks: int, ecs: int, rounds: int,
 
 
 def build_artifact(rungs, target, parity, trace, features,
-                   cluster=None, throughput=None) -> dict:
+                   cluster=None, throughput=None, scenario=None) -> dict:
     """The scored JSON line the driver records.
 
     Scores ONLY the target config (the north star, or the requested
@@ -1494,6 +1591,14 @@ def build_artifact(rungs, target, parity, trace, features,
         out["throughput"] = throughput
         if throughput.get("mode"):
             out["mode"] = throughput["mode"]
+    if scenario is not None:
+        # The scenario rung (trace-driven production-shaped workloads):
+        # per-scenario throughput, robustness-under-cost-perturbation,
+        # and staleness series for tools/bench_compare.py.  Mode marker
+        # as above — its identity legs drive the streaming engine.
+        out["scenario"] = scenario
+        if scenario.get("mode") and "mode" not in out:
+            out["mode"] = scenario["mode"]
     if best is None:
         out.update({"value": None, "vs_baseline": 0.0,
                     "error": f"target rung {target[0]}/{target[1]} "
@@ -1644,7 +1749,7 @@ def main(argv=None) -> int:
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--child",
                    choices=["rung", "parity", "trace", "features", "soak",
-                            "cluster", "throughput"],
+                            "cluster", "throughput", "scenario"],
                    default=None)
     p.add_argument("--seconds", type=float, default=6.0,
                    help="fixed duration for --child throughput's "
@@ -1702,6 +1807,11 @@ def main(argv=None) -> int:
             args.machines or 64, args.seconds, args.seed
         )))
         return 0
+    if args.child == "scenario":
+        print(json.dumps(run_scenario(
+            args.machines or 16, max(args.rounds, 6), args.seed
+        )))
+        return 0
     if args.child == "cluster":
         print(json.dumps(run_cluster_rung(
             args.machines or CLUSTER_RUNG[0],
@@ -1728,12 +1838,14 @@ def main(argv=None) -> int:
     features = {"ok": False, "error": "not run"}
     cluster = None
     throughput = None
+    scenario = None
 
     live_evidence = _load_last_live_tpu(target)  # once; None when absent
 
     def emit():
         art = build_artifact(rungs, target, parity, trace, features,
-                             cluster=cluster, throughput=throughput)
+                             cluster=cluster, throughput=throughput,
+                             scenario=scenario)
         if art.get("backend") != "tpu" and live_evidence is not None:
             art["last_live_tpu"] = live_evidence
         print(json.dumps(art), flush=True)
@@ -1804,6 +1916,16 @@ def main(argv=None) -> int:
             "--machines", "64", "--seconds", "6",
             "--seed", str(args.seed),
         ], rung_timeout_s())
+        emit()
+        # Scenario rung: the named production-shaped workloads, both
+        # loop modes + robustness scoring.  ~5 scenarios x (2 identity
+        # drives + N perturbed re-drives) full-stack sessions, so it
+        # gets a doubled child budget; like throughput it is a behavior
+        # claim and stays at modest scale.
+        scenario = _stage("scenario", [
+            "--machines", "16", "--rounds", "6",
+            "--seed", str(args.seed),
+        ], rung_timeout_s() * 2)
         emit()
     for machines, tasks in ladder[1:]:
         run_rung_child(machines, tasks)

@@ -18,6 +18,11 @@ needed to re-drive the soak offline to the identical failing round:
 ``FlightTrace.to_trace_events()`` lowers the workload onto the replay
 harness's ``TraceEvent`` vocabulary for planner-only offline analysis.
 
+The scenario harness (``poseidon_tpu/scenario``) records through the
+same recorder with ``spec["kind"] == "scenario"`` and the full
+``ScenarioPlan`` dict embedded at ``spec["plan"]`` — trace lowering and
+redrive dispatch on that kind; everything else is shared.
+
 Deliberately wall-clock-free (this module is in the posecheck
 ``determinism`` scan scope): rounds are the only time axis a
 reproducible trace can carry.
@@ -81,7 +86,16 @@ class FlightTrace:
         0, each round's pod batch becomes a ``job_submit`` at the round
         boundary), so ``replay.ReplayDriver`` can re-drive the same
         population planner-only — the offline triage path when the full
-        glue stack is not wanted."""
+        glue stack is not wanted.  Dispatches on ``spec["kind"]``:
+        scenario traces lower through the ScenarioPlan embedded in the
+        spec, everything else through the soak workload generator."""
+        if self.spec.get("kind") == "scenario":
+            from poseidon_tpu.scenario.plan import (
+                ScenarioPlan,
+                workload_events,
+            )
+
+            return workload_events(ScenarioPlan.from_dict(self.spec["plan"]))
         from poseidon_tpu.chaos.soak import workload_events
 
         return workload_events(self.spec)

@@ -4,8 +4,11 @@ One soak = FakeKube (wrapped in ``ChaoticKube``) + the real pod/node
 watchers + the real gRPC firmament-tpu service + the real
 ``FirmamentClient`` (fault-wrapped stubs) + the production schedule-loop
 failure policy (``Poseidon.try_round``), driven round by round with a
-seeded workload while the armed faults fire.  After EVERY round the
-harness asserts:
+seeded workload while the armed faults fire.  The stack itself — build,
+node-sync barrier, per-round drive/retry policy, quiesce, ledger
+windows, teardown — is the shared ``chaos/harness.py`` ``DriveStack``
+(also consumed by the scenario driver, ``scenario/drive.py``), so after
+EVERY round the soak asserts the single-sourced gates:
 
 - **zero state divergence**: the fake-kube truth (bound Running pods)
   and the scheduler's view (RUNNING tasks' placements), joined through
@@ -27,30 +30,41 @@ round.
 
 from __future__ import annotations
 
-import hashlib
-import json
 import logging
-import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from poseidon_tpu.chaos.inject import ChaoticKube, FaultInjector, chaotic_client
+from poseidon_tpu.chaos.harness import (
+    NODE_CPU,
+    NODE_RAM,
+    POD_SHAPES,
+    DriveFailure,
+    DriveStack,
+    LedgerWindow,
+    await_effect,
+    metrics_wire,
+    placement_views,
+    view_digest,
+)
+from poseidon_tpu.chaos.inject import FaultInjector
 from poseidon_tpu.chaos.plan import FaultPlan, named_plan
 from poseidon_tpu.chaos.recorder import FlightRecorder
 from poseidon_tpu.obs import trace as obs_trace
 
 log = logging.getLogger("poseidon.chaos.soak")
 
-# Pod request shapes: a narrow factor range so every round's pending set
-# falls into the same solver size bands (compile-shape stability is one
-# of the soak's gates, so the workload must not smuggle new compile keys
-# in mid-run).
-_POD_SHAPES = (
-    (200, 1 << 19), (400, 1 << 19), (400, 1 << 20), (800, 1 << 20),
-)
-_NODE_CPU = 32_000
-_NODE_RAM = 128 << 20
+# Compatibility aliases: these lived here before the drive stack was
+# factored into chaos/harness.py; external consumers (bench.py, tests)
+# import them under the old names.
+_POD_SHAPES = POD_SHAPES
+_NODE_CPU = NODE_CPU
+_NODE_RAM = NODE_RAM
+_await = await_effect
+_digest = view_digest
+_placement_views = placement_views
+_metrics_dict = metrics_wire
+SoakFailure = DriveFailure
 
 
 def _spec(name: str, seed: int, machines: int, rounds: int,
@@ -79,7 +93,7 @@ def _pod_batches(spec: dict) -> List[List[dict]]:
         )
         batch = []
         for i in range(n):
-            cpu, ram = _POD_SHAPES[int(rng.integers(len(_POD_SHAPES)))]
+            cpu, ram = POD_SHAPES[int(rng.integers(len(POD_SHAPES)))]
             batch.append({
                 "name": f"soak-r{r}-{i}",
                 "cpu": cpu,
@@ -98,7 +112,7 @@ def workload_events(spec: dict):
     from poseidon_tpu.replay.trace import TraceEvent
 
     events = [
-        TraceEvent(0.0, "machine_add", (i, _NODE_CPU, _NODE_RAM))
+        TraceEvent(0.0, "machine_add", (i, NODE_CPU, NODE_RAM))
         for i in range(spec["machines"])
     ]
     horizon = 10.0 * (spec["rounds"] + spec["settle_rounds"] + 1)
@@ -115,79 +129,6 @@ def workload_events(spec: dict):
             ))
     events.sort(key=lambda e: (e.time, e.kind))
     return events
-
-
-def _placement_views(kube, poseidon, server):
-    """(kube_truth, scheduler_view): pod key -> node name on both sides,
-    joined through the glue id maps.  Entries only the scheduler knows
-    surface under a synthetic ``<uid:...>`` key so they diverge loudly
-    instead of vanishing from the comparison."""
-    from poseidon_tpu.graph.state import TaskState
-
-    inner = kube.inner if isinstance(kube, ChaoticKube) else kube
-    kube_truth = {
-        pod.key: pod.node_name
-        for pod in inner.pods.values()
-        if pod.phase == "Running" and pod.node_name
-    }
-    sched_view = {}
-    st = server.servicer.state
-    with st._lock:
-        running = {
-            uid: task.scheduled_to
-            for uid, task in st.tasks.items()
-            if task.state == TaskState.RUNNING and task.scheduled_to
-        }
-    for uid, machine_uuid in running.items():
-        pod = poseidon.shared.task_for_uid(uid)
-        node = poseidon.shared.node_for_resource(machine_uuid)
-        key = pod.key if pod is not None else f"<uid:{uid}>"
-        sched_view[key] = node if node is not None else f"<res:{machine_uuid}>"
-    return kube_truth, sched_view
-
-
-def _digest(view: Dict[str, str]) -> str:
-    return hashlib.sha256(
-        json.dumps(sorted(view.items())).encode()
-    ).hexdigest()[:16]
-
-
-def _metrics_dict(metrics) -> dict:
-    # One wire format for a round's metrics everywhere (flight traces,
-    # bench sub-reports, the Prometheus exporter): the schema-versioned
-    # RoundMetrics.to_dict.
-    return metrics.to_dict()
-
-
-# The solve-tier vocabulary the byte-identity gate accepts.  Every tier
-# of the planner's degraded ladder is legitimate under chaos — including
-# "sharded" (the mesh-split dense solve, certified and deterministic) —
-# but a tier string outside the ladder means the planner and the soak
-# disagree about what ran, which no digest comparison can vouch for.
-_KNOWN_TIERS = ("none", "quiet", "pruned", "dense", "sharded",
-                "host_greedy")
-
-
-def _await(cond: Callable[[], bool], timeout: float) -> bool:
-    """Poll ``cond`` until true or deadline.  The watchers' drain
-    barrier alone is racy against the watch->KeyedQueue pump (an event
-    still in the watch queue is invisible to ``drain_watchers``), so the
-    soak synchronizes on the EFFECT — ids resolving in the glue's shared
-    maps — before trusting a drain."""
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return True
-        time.sleep(0.01)
-    return False
-
-
-class SoakFailure(Exception):
-    def __init__(self, kind: str, detail: str, round_index: int) -> None:
-        super().__init__(f"{kind} (round {round_index}): {detail}")
-        self.kind = kind
-        self.detail = detail
-        self.round_index = round_index
 
 
 def run_soak(
@@ -215,25 +156,7 @@ def run_soak(
     mutations; ``ctx`` exposes the live pieces (server, kube, poseidon,
     injector) so a test can, e.g., kill the Firmament stub mid-soak.
     """
-    from poseidon_tpu.check.ledger import (
-        NumericsLedger,
-        fresh_compile_count,
-        implicit_transfer_count,
-        numeric_anomaly_count,
-    )
-    from poseidon_tpu.glue.fake_kube import FakeKube, Node, Pod
-    from poseidon_tpu.glue.poseidon import Poseidon
-    from poseidon_tpu.utils.locks import (
-        lock_contention_ns,
-        lock_order_edge_count,
-        lock_order_edges,
-    )
-    from poseidon_tpu.ops.transport import bucket_size
-    from poseidon_tpu.service.server import FirmamentTPUServer
-    from poseidon_tpu.utils.config import (
-        FirmamentTPUConfig,
-        PoseidonConfig,
-    )
+    from poseidon_tpu.glue.fake_kube import Pod
 
     churn = churn if churn is not None else max(machines // 20, 4)
     spec = _spec(plan, seed, machines, rounds, pods_per_machine, churn,
@@ -259,91 +182,20 @@ def run_soak(
     if expect_digests is not None:
         result["digest_mismatches"] = []
 
-    # Precompile the solver ladder at the soak's scale before the first
-    # round, so round 0 pays every compile and the warm-round budget-0
-    # gate is unambiguous.
-    server_cfg = FirmamentTPUConfig(
-        precompile=True,
-        max_ecs=bucket_size(len(_POD_SHAPES) * 4, lo=8),
-        max_machines=0,
-    )
-    server = FirmamentTPUServer(
-        address="127.0.0.1:0", config=server_cfg
-    ).start()
-    kube = ChaoticKube(FakeKube(), injector)
-    client = chaotic_client(
-        server.address, injector,
-        rpc_timeout_s=10.0, rpc_retries=2, rpc_backoff_s=0.01,
-        rpc_backoff_max_s=0.05, retry_seed=seed,
-    )
-    cfg = PoseidonConfig(
-        firmament_address=server.address,
-        scheduling_interval=3600,
-        crash_loop_budget=4,
-        crash_backoff_s=0.01,
-        crash_backoff_max_s=0.05,
-    )
-    poseidon = Poseidon(
-        kube, config=cfg, firmament=client, run_loop=False
-    ).start(health_timeout=30)
-    server.servicer.planner.chaos = injector
+    stack = DriveStack(
+        machines, seed=seed, injector=injector, ledger_label="chaos soak"
+    ).start(health_timeout=30.0)
+    kube, poseidon = stack.kube, stack.poseidon
     ctx = {
-        "server": server, "kube": kube, "poseidon": poseidon,
+        "server": stack.server, "kube": kube, "poseidon": poseidon,
         "injector": injector,
     }
 
     def _round_faults(r: int) -> List[dict]:
         return [e for e in injector.fired if e["round"] == r]
 
-    # Span recording rides every soak (forced on without touching the
-    # process environment): each round's spans — glue loop, round
-    # stages, RPC attempts, watcher events — are drained into that
-    # round's flight record, so a failing round's timeline re-renders
-    # offline (replay/flight.flight_timeline) from the trace alone.
-    # Forced only once inside the try so the finally's restore is
-    # guaranteed to run — a setup failure must not leak force=True into
-    # the rest of the process.
-    _tracer = obs_trace.tracer()
-    _prev_force = _tracer.force
-    # Numerics-ledger window over the WHOLE soak: every host_fetch the
-    # soak drives is validated (finite floats, int32 fetch headroom) and
-    # every saturation-certificate trip attributed.  Telemetry mode
-    # (budget=None): the per-round counter diffs and the end-of-soak
-    # SoakFailure gate own the budget-0 assertion, so a numeric anomaly
-    # fails through the flight-recorder path like every other gate
-    # instead of as a bare exception out of a round body.
-    _numled = NumericsLedger(budget=None, label="chaos soak")
     try:
-        _tracer.force = True
-        _numled.__enter__()
-        obs_trace.drain_spans()  # a clean window: drop pre-soak spans
-        obs_trace.drain_counter_samples()
-        for node_i in range(machines):
-            kube.add_node(Node(
-                name=f"m{node_i:04d}",
-                cpu_capacity=_NODE_CPU, ram_capacity=_NODE_RAM,
-            ))
-        # Barrier on the EFFECT, then the drain: every node must resolve
-        # in the shared map (events left the watch queue) and the queues
-        # must empty (the NodeAdded RPCs completed) before round 0 —
-        # otherwise the service-side precompile sees a partial fleet.
-        synced = _await(
-            lambda: all(
-                poseidon.shared.get_node(f"m{i:04d}") is not None
-                for i in range(machines)
-            ),
-            30.0,
-        )
-        if not (synced and poseidon.drain_watchers(timeout=30.0)):
-            raise SoakFailure("setup", "node sync never drained", 0)
-        # Precompile SYNCHRONOUSLY, after the fleet registered (the
-        # machine bucket derives from the live cluster) and before any
-        # round's ledger window opens.  Left to the lazy first-Schedule
-        # path, precompile keeps running in that handler thread after
-        # the client's RPC deadline expires, and its compile-completion
-        # events straggle into warm rounds' windows — a false budget-0
-        # violation under load.
-        server.servicer.ensure_precompiled()
+        stack.arm(sync_timeout=30.0)
 
         for r in range(total_rounds):
             injector.begin_round(r)
@@ -382,7 +234,7 @@ def run_soak(
             # queue drain proves the RPCs behind them completed.
             if not injector.is_stalled("pods"):
                 created = [f"default/{p['name']}" for p in batches[r]]
-                _await(
+                await_effect(
                     lambda: all(
                         poseidon.shared.uid_for_pod(k) is not None
                         for k in created
@@ -394,53 +246,26 @@ def run_soak(
                 )
             poseidon.drain_watchers(timeout=30.0)
 
-            fresh0 = fresh_compile_count()
-            transfers0 = implicit_transfer_count()
-            edges0 = lock_order_edge_count()
-            contention0 = lock_contention_ns()
-            anoms0 = numeric_anomaly_count()
-            for _attempt in range(2 * (cfg.crash_loop_budget + 1)):
-                delay = poseidon.try_round()
-                if delay is None:
-                    raise SoakFailure(
-                        "fatal", poseidon.fatal or "loop stopped", r
-                    )
-                # Streaming (POSEIDON_STREAMING=1): the round returns
-                # with its enactment still in flight on the worker —
-                # join it before the ledger diff and the divergence
-                # gate read anything (a no-op in synchronous mode).  A
-                # failure parked on the worker surfaces at the NEXT
-                # try_round's join, so loop until a round both
-                # schedules AND enacts cleanly; each parked failure
-                # burns one extra attempt, hence the doubled bound
-                # (sync mode still exhausts the budget via delay=None
-                # exactly as before).
-                if not poseidon.drain_rounds(timeout=60.0):
-                    raise SoakFailure(
-                        "drain", "streaming enactment never drained", r
-                    )
-                if (poseidon.loop_stats.consecutive_failures == 0
-                        and not poseidon.enact_failed()):
-                    break
-                # Failed round: the soak compresses the backoff delay
-                # (the policy fired; sleeping it for real buys nothing).
-            fresh = fresh_compile_count() - fresh0
-            transfers = implicit_transfer_count() - transfers0
-            anoms = numeric_anomaly_count() - anoms0
-            new_edges = lock_order_edges()[edges0:]
+            window = LedgerWindow()
+            stack.drive_round(r, drain_timeout=60.0)
+            window.close()
             if r >= 1:
-                result["warm_fresh_compiles"] += fresh
+                result["warm_fresh_compiles"] += window.fresh_compiles
                 # The transfer budget-0 window rides NEXT to the compile
                 # one: a warm soak round doing implicit device->host
                 # syncs is the same silent-latency bug class
                 # (TransferLedger; posecheck transfer-discipline).
-                result["warm_implicit_transfers"] += transfers
+                result["warm_implicit_transfers"] += (
+                    window.implicit_transfers
+                )
                 # Fourth budget-0 gate (NumericsLedger): the soak-wide
                 # window validates every fetched value, so a warm-round
                 # anomaly means a solve handed the planner a non-finite
                 # or rail-riding number — silent corruption, the
                 # numeric twin of a fresh compile in a warm round.
-                result["warm_numeric_anomalies"] += anoms
+                result["warm_numeric_anomalies"] += (
+                    window.numeric_anomalies
+                )
                 # Third budget-0 gate (LockLedger): round 0 latches the
                 # steady-state lock-acquisition-order graph; a WARM
                 # round growing it means a thread explored a nesting no
@@ -448,56 +273,16 @@ def run_soak(
                 # candidate) path, the dynamic twin of posecheck's
                 # lock-order rule.
                 result["warm_lock_order_edges"].extend(
-                    f"{a} -> {b} ({site})" for a, b, site in new_edges
+                    window.new_lock_order_edges
                 )
 
-            # Quiesce before the divergence gate: release chaos-held
-            # event streams (their damage — a round solved on stale
-            # knowledge — is done) and let the watchers drain, so the
-            # comparison sees the reconciled state, not delivery lag.
-            # The gate itself then waits briefly for a match: delivery
-            # lag is transient and resolves under the wait, while a real
-            # divergence (a phantom placement, a missed rollback) is a
-            # fixed point no amount of waiting heals — THAT is what
-            # fails the soak.
-            injector.flush_events()
-            poseidon.drain_watchers(timeout=30.0)
-            kube_truth, sched_view = _placement_views(
-                kube, poseidon, server
-            )
-            if kube_truth != sched_view:
-                def _matches() -> bool:
-                    a, b = _placement_views(kube, poseidon, server)
-                    return a == b
-                _await(_matches, 10.0)
-                kube_truth, sched_view = _placement_views(
-                    kube, poseidon, server
-                )
-            metrics = server.servicer.planner.last_metrics
-            metrics_d = _metrics_dict(metrics)
-            # The soak-level ledger diff covers the WHOLE round attempt
-            # (retries, precompile, watcher work), not just the
-            # planner's own solve window — record both.
-            metrics_d["soak_fresh_compiles"] = fresh
-            metrics_d["soak_implicit_transfers"] = transfers
-            metrics_d["soak_numeric_anomalies"] = anoms
-            metrics_d["soak_lock_order_edges"] = len(new_edges)
-            metrics_d["soak_lock_contention_ns"] = (
-                lock_contention_ns() - contention0
-            )
-            result["lock_contention_ns"] += (
-                lock_contention_ns() - contention0
-            )
-            if metrics.solve_tier not in _KNOWN_TIERS:
-                raise SoakFailure(
-                    "unknown-tier",
-                    f"solve_tier {metrics.solve_tier!r} outside the "
-                    f"ladder vocabulary {_KNOWN_TIERS}",
-                    r,
-                )
-            result["tiers"].append(metrics.solve_tier)
+            kube_truth, sched_view = stack.quiesce(heal_timeout=10.0)
+            metrics = stack.server.servicer.planner.last_metrics
+            metrics_d = window.stamp(metrics_wire(metrics), prefix="soak")
+            result["lock_contention_ns"] += window.lock_contention_ns
+            result["tiers"].append(stack.check_tier(metrics, r))
             result["cost_delta_hits"] += metrics.cost_delta_hits
-            digest = _digest(kube_truth)
+            digest = view_digest(kube_truth)
             result["digests"].append(digest)
             result["rounds_run"] = r + 1
             recorder.record_round(
@@ -537,10 +322,7 @@ def run_soak(
                 )
 
         if until_round is None:
-            pending = sorted(
-                pod.key for pod in kube.inner.pods.values()
-                if pod.phase == "Pending"
-            )
+            pending = stack.pending_pods()
             if pending:
                 raise SoakFailure(
                     "unplaced",
@@ -593,25 +375,9 @@ def run_soak(
         log.error("soak failed (%s); flight trace: %s",
                   e, result["trace_path"])
     finally:
-        _numled.__exit__(None, None, None)  # no-op if never entered
-        _tracer.force = _prev_force
-        poseidon.stop()
-        try:
-            server.stop(grace=0.2)
-        except Exception:  # noqa: BLE001 - a killed-mid-soak server is fine
-            pass
-        client.close()
+        stack.stop()
 
     result["fired"] = list(injector.fired)
-    result["resyncs"] = (
-        poseidon.pod_watcher.resyncs + poseidon.node_watcher.resyncs
-    )
-    stats = poseidon.loop_stats
-    result["loop_stats"] = {
-        "rounds": stats.rounds, "placed": stats.placed,
-        "preempted": stats.preempted, "migrated": stats.migrated,
-        "failed_rounds": stats.failed_rounds,
-        "bind_failures": stats.bind_failures,
-        "requeued": stats.requeued,
-    }
+    result["resyncs"] = stack.resyncs
+    result["loop_stats"] = stack.loop_stats_dict()
     return result

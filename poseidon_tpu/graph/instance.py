@@ -195,8 +195,9 @@ class RoundMetrics:
     # admission cut and rolled to round N+1.  admission_staleness_s: age
     # of the OLDEST delta admitted into this round at the cut (the
     # bounded-staleness bound actually realized).  placements_per_sec is
-    # stamped by the glue loop (placed / round wall) — the service-side
-    # solve path leaves it 0.
+    # stamped by the planner itself at the end of schedule_round
+    # (placed / total wall), in BOTH loop modes; 0.0 only for an
+    # empty/instant round.
     overlap_fraction: float = 0.0
     admission_deferred: int = 0
     admission_staleness_s: float = 0.0
@@ -538,6 +539,22 @@ class RoundPlanner:
         # production; the solve path itself is unchanged when unset.
         self.chaos = None
 
+    def set_cost_model(self, cost_model) -> None:
+        """Swap the cost model before a drive's first round (the
+        scenario robustness scorer installs a ``PerturbedCostModel``
+        here, in the style of the ``chaos`` seam above).  Rebuilds the
+        delta-plane cache and drops certificate/shortlist reuse — every
+        cached cell priced by the OLD model is invalid under the new
+        one; warm solver frames survive (prices re-anneal under the
+        epsilon ladder regardless of the cost surface)."""
+        from poseidon_tpu.costmodel.delta import CostPlaneCache
+
+        self.cost_model = cost_model
+        self._plane_cache = CostPlaneCache(cost_model)
+        self._last_build_stats = self._plane_cache.last_stats
+        self._cert_bands = {}
+        self._shortlist_bands = {}
+
     # ------------------------------------------------------------- warm frames
 
     def export_warm_state(self) -> dict:
@@ -778,7 +795,14 @@ class RoundPlanner:
                     # sparse probe supply would otherwise reduce and
                     # skip the very shape dense rounds need); the
                     # sharded dispatch never reduces, so it keeps the
-                    # configured path.
+                    # configured path.  greedy_init is OFF for every
+                    # probe: an easy probe instance whose greedy start
+                    # certifies exactly is answered by the host
+                    # short-circuit with NO device dispatch, silently
+                    # skipping the very compile key this loop exists to
+                    # mint (observed at small buckets: the first real
+                    # round that misses the host certificate then pays
+                    # a fresh mid-round compile).
                     if self.solver_devices > 1 and (
                         scale is None
                         or width == coarse_group_count(m_bucket)
@@ -790,18 +814,19 @@ class RoundPlanner:
                         # sharding — its dispatch never reduces.
                         self._dispatch_solve(
                             costs, supply, cap, unsched, arc_capacity=arc,
-                            max_cost_hint=hint,
+                            max_cost_hint=hint, greedy_init=False,
                             **({} if scale is None else {"scale": scale}),
                         )
                     elif scale is not None:
                         solve_transport(
                             costs, supply, cap, unsched, arc_capacity=arc,
                             max_cost_hint=hint, scale=scale,
+                            greedy_init=False,
                         )
                     else:
                         solve_transport(
                             costs, supply, cap, unsched, arc_capacity=arc,
-                            max_cost_hint=hint,
+                            max_cost_hint=hint, greedy_init=False,
                         )
                         tier_mesh = self._sharded_band_mesh(width)
                         if tier_mesh is not None:
@@ -814,7 +839,7 @@ class RoundPlanner:
                             self._dispatch_solve(
                                 costs, supply, cap, unsched,
                                 arc_capacity=arc, max_cost_hint=hint,
-                                sharded_mesh=tier_mesh,
+                                sharded_mesh=tier_mesh, greedy_init=False,
                             )
                             compiled += 1
                     compiled += 1
@@ -831,6 +856,13 @@ class RoundPlanner:
         decomposes the round without consulting the metrics stream."""
         with _trace.span("round") as sp:
             deltas, metrics = self._schedule_round()
+            # Stamped here — not in the glue loops — so the figure rides
+            # the wire identically whether the round was driven by the
+            # synchronous loop, the streaming engine, or bench.
+            if metrics.total_seconds > 0:
+                metrics.placements_per_sec = round(
+                    metrics.placed / metrics.total_seconds, 3
+                )
             sp.set(
                 round=metrics.round_index,
                 solve_tier=metrics.solve_tier,

@@ -1,15 +1,17 @@
 """Flight-trace loading + offline re-drive.
 
 A soak failure (poseidon_tpu/chaos) leaves a ``FlightTrace`` JSON under
-``out/soak/``.  This module is the replay-side consumer:
+``out/soak/``; a scenario failure (poseidon_tpu/scenario) leaves one
+under ``out/scenario/`` with ``spec["kind"] == "scenario"``.  This
+module is the replay-side consumer:
 
 - ``load_flight(path)`` parses the trace;
-- ``redrive_flight(path)`` reconstructs the SAME soak — seeded workload,
-  same fault plan — and re-drives it round by round up to the recorded
-  failing round, checking each round's placement digest against the
-  recorded one.  A clean re-drive (``reproduced=True``) means the
-  failure's entire input state is on disk and the failing round can be
-  studied offline at will;
+- ``redrive_flight(path)`` reconstructs the SAME run — seeded soak
+  workload + fault plan, or the embedded ScenarioPlan — and re-drives
+  it round by round up to the recorded failing round, checking each
+  round's placement digest against the recorded one.  A clean re-drive
+  (``reproduced=True``) means the failure's entire input state is on
+  disk and the failing round can be studied offline at will;
 - ``flight_trace_events(path)`` lowers the workload onto the replay
   harness's ``TraceEvent`` vocabulary for planner-only analysis
   (``ReplayDriver`` accepts the result directly — no glue stack, no
@@ -96,21 +98,44 @@ def flight_timeline(path: str, round_index: Optional[int] = None,
 
 
 def redrive_flight(path: str) -> dict:
-    """Re-drive a recorded soak to its failing round.
+    """Re-drive a recorded soak or scenario to its failing round.
 
-    Returns the re-drive's soak result plus ``reproduced``: True when
+    Returns the re-drive's result plus ``reproduced``: True when
     every re-driven round's placement digest matches the recording —
     i.e. the trace deterministically reconstructs the exact pre-failure
     state.  The failure itself (a killed service, a divergence) is an
     environmental event the re-drive does NOT repeat; what it proves is
-    that the recorded inputs land you on the identical failing round."""
-    from poseidon_tpu.chaos.soak import run_soak
+    that the recorded inputs land you on the identical failing round.
 
+    Dispatches on ``spec["kind"]``: scenario traces re-drive the
+    embedded ``ScenarioPlan`` through ``scenario.drive_scenario`` in the
+    recorded loop mode (and with the recorded cost-perturbation seed,
+    if any); everything else re-drives through ``chaos.soak.run_soak``."""
     trace = load_flight(path)
     spec = trace.spec
     failure = trace.failure or {}
     failing_round = int(failure.get("round", len(trace.rounds)))
     expect = [r["digest"] for r in trace.rounds]
+    if spec.get("kind") == "scenario":
+        from poseidon_tpu.scenario.drive import drive_scenario
+        from poseidon_tpu.scenario.plan import ScenarioPlan
+
+        result = drive_scenario(
+            ScenarioPlan.from_dict(spec["plan"]),
+            streaming=bool(spec.get("streaming")),
+            perturb_seed=spec.get("perturb_seed"),
+            amplitude=spec.get("amplitude"),
+            until_round=failing_round,
+            expect_digests=expect,
+        )
+        result["failing_round"] = failing_round
+        result["reproduced"] = (
+            result.get("reproduced", False)
+            and result["rounds_run"] == failing_round
+        )
+        return result
+    from poseidon_tpu.chaos.soak import run_soak
+
     result = run_soak(
         machines=int(spec["machines"]),
         rounds=int(spec["rounds"]),

@@ -1,0 +1,48 @@
+"""Trace-driven scenario harness: production-shaped workload
+generators, full-stack drives in both loop modes, robustness scoring
+under chaos-seeded cost perturbation, and flight-recorder replay.
+
+- ``plan``: declarative, seeded ``ScenarioPlan`` (the FaultPlan twin);
+- ``generate``: the named scenario registry (diurnal, flash_crowd,
+  node_churn, rolling_restart, multi_tenant);
+- ``drive``: a plan through the FULL glue loop with the shared harness
+  gates (chaos/harness.py), sync or streaming;
+- ``score``: robustness = objective-regression quantiles across
+  perturbation seeds (docs/SCENARIOS.md has the metric definition).
+"""
+
+from poseidon_tpu.scenario.drive import (
+    drive_scenario,
+    scenario_digest,
+    scenario_out_dir,
+)
+from poseidon_tpu.scenario.generate import (
+    SCENARIOS,
+    SETTLE_ROUNDS,
+    named_scenario,
+)
+from poseidon_tpu.scenario.plan import (
+    PodArrival,
+    ScenarioPlan,
+    ScenarioRound,
+    workload_events,
+)
+from poseidon_tpu.scenario.score import (
+    PerturbedCostModel,
+    score_scenario,
+)
+
+__all__ = [
+    "SCENARIOS",
+    "SETTLE_ROUNDS",
+    "PodArrival",
+    "PerturbedCostModel",
+    "ScenarioPlan",
+    "ScenarioRound",
+    "drive_scenario",
+    "named_scenario",
+    "scenario_digest",
+    "scenario_out_dir",
+    "score_scenario",
+    "workload_events",
+]

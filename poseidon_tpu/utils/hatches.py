@@ -241,6 +241,18 @@ HATCHES: Tuple[Hatch, ...] = (
     Hatch("POSEIDON_ENTRY_NO_PROBE", "flag", "",
           "Entry-point probe latch (set by __graft_entry__ after its "
           "single backend probe)"),
+    # ------------------------------------------------------------- scenarios
+    Hatch("POSEIDON_SCENARIO_OUT", "str", "out/scenario",
+          "Flight-trace output directory for scenario drives "
+          "(scenario/drive.py; replay/flight.py re-drives traces from "
+          "here)"),
+    Hatch("POSEIDON_SCENARIO_AMPLITUDE", "float", "0.15",
+          "Cost-perturbation amplitude for robustness scoring, as a "
+          "fraction of NORMALIZED_COST added to every admissible cost "
+          "cell (scenario/score.PerturbedCostModel)"),
+    Hatch("POSEIDON_SCENARIO_SEEDS", "int", "3",
+          "How many chaos-seeded cost-perturbation drives a scenario "
+          "robustness score aggregates (scenario/score.score_scenario)"),
     # -------------------------------------------------------------- external
     Hatch("POSEIDON_PERF_GATE", "external", "",
           "Set to `warn` to downgrade `make perf-gate` to warn-only on "

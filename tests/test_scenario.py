@@ -1,0 +1,412 @@
+"""Scenario subsystem unit tier: generator determinism, plan wire
+forms, named-scenario shape properties, the trace lowering, the
+perturbed cost model's purity contracts, and the round-metrics
+placements_per_sec wire pin.
+
+Everything here is planner-side or pure — no glue stack, no gRPC, no
+drives.  The full-stack drive gates (sync/streaming identity, budget-0
+warm ledgers, robustness scoring, flight redrive) live in the
+slow-marked ``tests/test_scenario_smoke.py`` (``make scenario-smoke``).
+"""
+
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from poseidon_tpu.costmodel.base import (
+    CostMatrices,
+    CostModel,
+    NORMALIZED_COST,
+)
+from poseidon_tpu.graph.instance import RoundMetrics
+from poseidon_tpu.scenario.generate import (
+    SCENARIOS,
+    SETTLE_ROUNDS,
+    named_scenario,
+)
+from poseidon_tpu.scenario.plan import (
+    PodArrival,
+    ScenarioPlan,
+    ScenarioRound,
+    kv,
+    workload_events,
+)
+from poseidon_tpu.scenario.score import PerturbedCostModel
+
+MACHINES = 16
+ROUNDS = 8
+
+INF_COST = 1 << 28
+
+
+# --------------------------------------------------------------- generators
+
+
+def test_generator_determinism_randomized():
+    """Same (name, seed, machines, rounds) -> bit-identical plan, for
+    every registered scenario across a spread of seeds; different seeds
+    must move the digest."""
+    seeds = (0, 3, 7, 1234, 999983)
+    for name in SCENARIOS:
+        digests = set()
+        for seed in seeds:
+            a = named_scenario(
+                name, machines=MACHINES, rounds=ROUNDS, seed=seed
+            )
+            b = named_scenario(
+                name, machines=MACHINES, rounds=ROUNDS, seed=seed
+            )
+            assert a.to_json() == b.to_json(), (name, seed)
+            assert a.digest() == b.digest(), (name, seed)
+            digests.add(a.digest())
+        assert len(digests) == len(seeds), (
+            f"{name}: seeds collided on a digest"
+        )
+
+
+def test_generator_streams_independent_across_names():
+    """Two scenarios sharing a seed must not share an RNG stream (the
+    name is folded into the seed key)."""
+    plans = {
+        name: named_scenario(name, machines=MACHINES, rounds=ROUNDS, seed=5)
+        for name in SCENARIOS
+    }
+    digests = {p.digest() for p in plans.values()}
+    assert len(digests) == len(SCENARIOS)
+
+
+def test_plan_wire_roundtrip():
+    for name in SCENARIOS:
+        p = named_scenario(name, machines=MACHINES, rounds=ROUNDS, seed=2)
+        assert ScenarioPlan.from_dict(p.to_dict()) == p
+        assert ScenarioPlan.from_json(p.to_json()) == p
+        assert ScenarioPlan.from_json(p.to_json()).digest() == p.digest()
+
+
+def test_registry_unknown_name():
+    with pytest.raises(KeyError, match="unknown scenario"):
+        named_scenario("thundering_herd")
+
+
+def test_round_contiguity_enforced():
+    with pytest.raises(ValueError, match="contiguous"):
+        ScenarioPlan(
+            name="bad", seed=0, machines=4,
+            rounds=(ScenarioRound(round_index=1),),
+        )
+
+
+def test_every_plan_ends_with_settle_rounds():
+    for name in SCENARIOS:
+        p = named_scenario(name, machines=MACHINES, rounds=ROUNDS, seed=0)
+        assert p.total_rounds == ROUNDS + SETTLE_ROUNDS
+        for rnd in p.rounds[-SETTLE_ROUNDS:]:
+            assert not rnd.arrivals
+            assert rnd.completions > 0  # settle keeps draining
+
+
+# ------------------------------------------------------- scenario shapes
+
+
+def test_flash_crowd_burst_shape():
+    p = named_scenario(
+        "flash_crowd", machines=MACHINES, rounds=ROUNDS, seed=0
+    )
+    burst_round = max(ROUNDS // 2, 2)
+    quiet = len(p.rounds[1].arrivals)
+    burst = len(p.rounds[burst_round].arrivals)
+    assert burst >= 4 * quiet
+    # The crowd is owner-grouped (job-shaped), the baseline is not.
+    assert all(a.owner for a in p.rounds[burst_round].arrivals)
+    assert all(not a.owner for a in p.rounds[1].arrivals)
+
+
+def test_node_churn_fleet_motion():
+    p = named_scenario(
+        "node_churn", machines=MACHINES, rounds=ROUNDS, seed=0
+    )
+    added = [n for r in p.rounds for n in r.add_nodes]
+    drained = [n for r in p.rounds for n in r.drain_nodes]
+    assert added and drained
+    assert len(drained) <= len(added)  # capacity never net-shrinks
+    # Fresh nodes get fresh names; drains hit the original fleet.
+    assert all(int(n[1:]) >= MACHINES for n in added)
+    assert all(int(n[1:]) < MACHINES for n in drained)
+
+
+def test_rolling_restart_steady_population():
+    p = named_scenario(
+        "rolling_restart", machines=MACHINES, rounds=ROUNDS, seed=0
+    )
+    for r in range(1, ROUNDS):
+        rnd = p.rounds[r]
+        assert len(rnd.arrivals) == rnd.completions  # wave in == wave out
+        assert all(a.owner.startswith("restart-deploy-")
+                   for a in rnd.arrivals)
+
+
+def test_diurnal_curve_breathes():
+    p = named_scenario("diurnal", machines=MACHINES, rounds=ROUNDS, seed=0)
+    active = [len(r.arrivals) for r in p.rounds[1:ROUNDS]]
+    assert max(active) > min(active)  # the sinusoid actually moves
+
+
+def test_multi_tenant_constraints_and_zones():
+    p = named_scenario(
+        "multi_tenant", machines=MACHINES, rounds=ROUNDS, seed=0
+    )
+    labels = p.node_label_map()
+    assert set(labels) == {f"m{i:04d}" for i in range(MACHINES)}
+    assert {d["zone"] for d in labels.values()} == {"z0", "z1", "z2"}
+
+    arrivals = [a for r in p.rounds for a in r.arrivals]
+    gangs = [a for a in arrivals
+             if dict(a.labels).get("gangScheduling") == "true"]
+    serving = [a for a in arrivals if a.pod_anti_affinity]
+    be = [a for a in arrivals if dict(a.labels).get("tenant") == "be"]
+    assert gangs and serving and be
+
+    # Whole gangs only: every gang owner groups >= 2 identically-shaped
+    # pods (a partial or mixed-shape gang would break atomic placement).
+    by_owner = {}
+    for a in gangs:
+        assert a.owner
+        assert dict(a.node_selector) == {"zone": "z0"}
+        by_owner.setdefault(a.owner, []).append(a)
+    for members in by_owner.values():
+        assert len(members) >= 2
+        assert len({(m.cpu, m.ram) for m in members}) == 1
+
+    # Serving replicas: zone-pinned, anti-affine against their own app.
+    for a in serving:
+        assert dict(a.node_selector) == {"zone": "z1"}
+        assert dict(a.pod_anti_affinity) == {"app": dict(a.labels)["app"]}
+
+    # Constraint fan-out is why this scenario's EC bucket is the widest.
+    assert p.max_window_ec_keys() > named_scenario(
+        "diurnal", machines=MACHINES, rounds=ROUNDS, seed=0
+    ).max_window_ec_keys()
+
+
+def test_ec_key_gang_owner_split():
+    shape = dict(cpu=400, ram=1 << 19)
+    gang = kv({"gangScheduling": "true"})
+    a = PodArrival(name="a", owner="j1", labels=gang, **shape)
+    b = PodArrival(name="b", owner="j2", labels=gang, **shape)
+    c = PodArrival(name="c", owner="j1", **shape)
+    d = PodArrival(name="d", owner="j2", **shape)
+    assert a.ec_key() != b.ec_key()  # gangs solve per owner
+    assert c.ec_key() == d.ec_key()  # plain pods aggregate across owners
+
+
+# ----------------------------------------------------------- trace lowering
+
+
+def test_workload_events_lowering():
+    p = named_scenario(
+        "node_churn", machines=MACHINES, rounds=ROUNDS, seed=0
+    )
+    events = workload_events(p)
+    kinds = {e.kind for e in events}
+    assert kinds == {"machine_add", "machine_remove", "job_submit"}
+    assert [e.kind for e in events if e.time == 0.0].count(
+        "machine_add"
+    ) == MACHINES
+    assert [(e.time, e.kind) for e in events] == sorted(
+        (e.time, e.kind) for e in events
+    )
+    # job_submit payload is (id, count, cpu, ram, deadline): the counts
+    # must account for every planned arrival.
+    submitted = sum(e.payload[1] for e in events if e.kind == "job_submit")
+    assert submitted == p.total_arrivals()
+
+
+# ------------------------------------------------------ perturbed cost model
+
+
+class _StubModel(CostModel):
+    """Content-pure stand-in: cost[e, m] depends only on (ec_id, uuid),
+    with a deterministic sprinkling of inadmissible (INF) cells — so
+    slice-purity of the wrapper is testable against slice-purity of the
+    base."""
+
+    name = "stub"
+    delta_plane = True  # the wrapper must force its own off
+
+    def _ukeys(self, uuids):
+        return np.array([sum(u.encode()) % 300 for u in uuids],
+                        dtype=np.int64)
+
+    def build(self, ecs, machines):
+        row = (ecs.ec_ids.astype(np.int64) % 500)[:, None]
+        col = self._ukeys(machines.uuids)[None, :]
+        costs = (row + col + 100).astype(np.int32)
+        costs[(row + col) % 5 == 0] = INF_COST
+        e, m = costs.shape
+        return CostMatrices(
+            costs=costs,
+            unsched_cost=np.full(e, 7 * NORMALIZED_COST, dtype=np.int32),
+            capacity=np.full(m, 16, dtype=np.int32),
+            arc_capacity=np.full((e, m), 4, dtype=np.int32),
+        )
+
+    def build_unsched(self, ecs):
+        return np.full(ecs.ec_ids.shape[0], 7 * NORMALIZED_COST,
+                       dtype=np.int32)
+
+    def build_capacity(self, machines):
+        return np.full(len(machines.uuids), 16, dtype=np.int32)
+
+    def max_cost(self):
+        return 8 * NORMALIZED_COST
+
+
+def _tables(n_ecs=12, n_machines=9):
+    ecs = SimpleNamespace(ec_ids=np.arange(
+        101, 101 + 17 * n_ecs, 17, dtype=np.uint64
+    ))
+    machines = SimpleNamespace(
+        uuids=[f"uuid-{i:03d}-{'ab'[i % 2]}" for i in range(n_machines)]
+    )
+    return ecs, machines
+
+
+def test_perturbed_model_contracts():
+    inner = _StubModel()
+    ecs, machines = _tables()
+    amplitude = 0.25
+    pm = PerturbedCostModel(inner, seed=11, amplitude=amplitude)
+
+    # Wrapper identity: delta-plane forced off, seed in the name,
+    # feasibility surfaces forwarded untouched.
+    assert pm.delta_plane is False
+    assert pm.name == "stub+perturb11"
+    assert pm.max_cost() == inner.max_cost()
+    np.testing.assert_array_equal(
+        pm.build_unsched(ecs), inner.build_unsched(ecs)
+    )
+    np.testing.assert_array_equal(
+        pm.build_capacity(machines), inner.build_capacity(machines)
+    )
+
+    base = inner.build(ecs, machines)
+    out = pm.build(ecs, machines)
+    inf = base.costs >= INF_COST
+    # Inadmissible arcs never move; capacity/unsched ride through.
+    np.testing.assert_array_equal(out.costs[inf], base.costs[inf])
+    np.testing.assert_array_equal(out.capacity, base.capacity)
+    np.testing.assert_array_equal(out.arc_capacity, base.arc_capacity)
+    np.testing.assert_array_equal(out.unsched_cost, base.unsched_cost)
+    # Admissible cells stay inside the static bound (no fresh compile
+    # keys) and within the amplitude band, and the noise actually bites.
+    adm = ~inf
+    assert out.costs[adm].min() >= 0
+    assert out.costs[adm].max() <= inner.max_cost()
+    bound = amplitude * NORMALIZED_COST + 1
+    assert np.abs(
+        out.costs[adm].astype(np.int64) - base.costs[adm]
+    ).max() <= bound
+    assert np.any(out.costs[adm] != base.costs[adm])
+
+
+def test_perturbed_model_determinism_and_seed_sensitivity():
+    inner = _StubModel()
+    ecs, machines = _tables()
+    a = PerturbedCostModel(inner, seed=3, amplitude=0.2)
+    b = PerturbedCostModel(inner, seed=3, amplitude=0.2)
+    c = PerturbedCostModel(inner, seed=4, amplitude=0.2)
+    np.testing.assert_array_equal(
+        a.build(ecs, machines).costs, b.build(ecs, machines).costs
+    )
+    assert np.any(
+        a.build(ecs, machines).costs != c.build(ecs, machines).costs
+    )
+
+
+def test_perturbed_model_slice_purity():
+    """A cell's perturbed price is a pure function of (seed, EC id,
+    machine uuid): pricing a row/column subset must reproduce the
+    corresponding cells of the full build exactly."""
+    inner = _StubModel()
+    ecs, machines = _tables()
+    pm = PerturbedCostModel(inner, seed=9, amplitude=0.3)
+    full = pm.build(ecs, machines).costs
+
+    rows = [1, 4, 7, 10]
+    cols = [0, 2, 5, 8]
+    sub_ecs = SimpleNamespace(ec_ids=ecs.ec_ids[rows])
+    sub_machines = SimpleNamespace(
+        uuids=[machines.uuids[c] for c in cols]
+    )
+    sub = pm.build(sub_ecs, sub_machines).costs
+    np.testing.assert_array_equal(sub, full[np.ix_(rows, cols)])
+
+
+# -------------------------------------------------------- scenario metrics
+
+
+def test_observe_scenario_exposition():
+    """The scenario rung's Prometheus face: one gauge family per
+    headline series, labelled by scenario name."""
+    from poseidon_tpu.obs import metrics as obs_metrics
+
+    reg = obs_metrics.Registry()
+    obs_metrics.observe_scenario(
+        "diurnal", robustness_score=0.8, placements_per_sec=123.0,
+        regression_p90=0.25, placement_divergence=0.5,
+        admission_staleness_p50_s=0.01, admission_staleness_p99_s=0.09,
+        ok=True, registry=reg,
+    )
+    obs_metrics.observe_scenario("node_churn", ok=False, registry=reg)
+    text = reg.expose()
+    assert 'poseidon_scenario_robustness_score{scenario="diurnal"} 0.8' \
+        in text
+    assert 'poseidon_scenario_placements_per_sec{scenario="diurnal"} ' \
+        "123" in text
+    assert 'poseidon_scenario_ok{scenario="diurnal"} 1' in text
+    assert 'poseidon_scenario_ok{scenario="node_churn"} 0' in text
+
+
+# ------------------------------------------------- placements/sec wire pin
+
+
+def test_round_metrics_placements_per_sec_wire():
+    """Satellite pin: placements_per_sec is a first-class RoundMetrics
+    wire field — serialized by to_dict, round-tripped by from_dict, and
+    defaulted (not erred) when absent from an older artifact."""
+    m = RoundMetrics(round_index=2, placed=50, total_seconds=2.0,
+                     placements_per_sec=25.0)
+    d = m.to_dict()
+    assert d["placements_per_sec"] == 25.0
+    assert RoundMetrics.from_dict(d).placements_per_sec == 25.0
+    legacy = {k: v for k, v in d.items() if k != "placements_per_sec"}
+    assert RoundMetrics.from_dict(legacy).placements_per_sec == 0.0
+
+
+def test_planner_stamps_placements_per_sec_sync():
+    """The planner itself stamps the throughput figure at the end of
+    schedule_round — so the synchronous loop reports it too, not just
+    the streaming engine (which used to compute it glue-side)."""
+    from poseidon_tpu.costmodel import get_cost_model
+    from poseidon_tpu.graph.instance import RoundPlanner
+    from poseidon_tpu.graph.state import ClusterState, MachineInfo, TaskInfo
+    from poseidon_tpu.utils.ids import generate_uuid, task_uid
+
+    state = ClusterState()
+    for i in range(4):
+        state.node_added(MachineInfo(
+            uuid=generate_uuid(f"pps-m{i}"), cpu_capacity=32000,
+            ram_capacity=128 << 20, task_slots=16,
+        ))
+    for i in range(6):
+        state.task_submitted(TaskInfo(
+            uid=task_uid("pps", i), job_id="pps-j",
+            cpu_request=400, ram_request=1 << 19,
+        ))
+    planner = RoundPlanner(state, get_cost_model("cpu_mem"))
+    _, m = planner.schedule_round()
+    assert m.placed == 6
+    assert m.total_seconds > 0
+    assert m.placements_per_sec == round(m.placed / m.total_seconds, 3)
+    assert m.placements_per_sec > 0

@@ -1,0 +1,146 @@
+"""Scenario-harness smoke (``make scenario-smoke``): the acceptance
+gate for the trace-driven scenario subsystem.
+
+Every registered production-shaped scenario drives through the FULL
+glue stack (FakeKube + watchers + gRPC service + the production round
+loop, via the shared chaos/harness.py stack) with every gate armed —
+kube-truth byte-identity per round, the budget-0 warm ledger quartet,
+tier vocabulary, and the end-of-drive "everything placed" check.  Then
+the drain-equivalence leg (synchronous vs streaming drives of one plan
+must produce identical placement AND delta-stream digests), seeded
+determinism across re-runs, robustness scoring under chaos-seeded cost
+perturbation, and the flight-recorder path: a deliberately killed
+drive must write a trace that re-drives offline to the identical
+failing round.
+
+Slow-marked: excluded from the tier-1 gate, run via
+``make scenario-smoke`` (wired into ``make verify``) or
+``pytest -m slow``.
+"""
+
+import pytest
+
+from poseidon_tpu.chaos.harness import KNOWN_TIERS
+from poseidon_tpu.replay import (
+    ReplayDriver,
+    flight_trace_events,
+    load_flight,
+    redrive_flight,
+)
+from poseidon_tpu.scenario import (
+    SCENARIOS,
+    SETTLE_ROUNDS,
+    drive_scenario,
+    named_scenario,
+    score_scenario,
+)
+
+pytestmark = pytest.mark.slow
+
+MACHINES = 8
+ROUNDS = 5
+SEED = 3
+
+
+def _plan(name):
+    return named_scenario(name, machines=MACHINES, rounds=ROUNDS, seed=SEED)
+
+
+def test_scenario_registry_full_stack_sync(tmp_path):
+    """Every named scenario drives clean through the full stack in the
+    synchronous loop with all gates armed."""
+    for name in SCENARIOS:
+        out = drive_scenario(_plan(name), out_dir=str(tmp_path))
+        assert out["ok"], (name, out.get("failure"))
+        assert out["rounds_run"] == ROUNDS + SETTLE_ROUNDS, name
+        # The per-round gates are enforced inside the drive (they fail
+        # it); restate the artifact contract here.
+        assert out["divergent_rounds"] == 0, name
+        assert out["warm_fresh_compiles"] == 0, name
+        assert out["warm_implicit_transfers"] == 0, name
+        assert set(out["tiers"]) <= set(KNOWN_TIERS), name
+        # Satellite pin: the planner stamps throughput in the sync loop
+        # too — the scenario artifact must carry a real figure.
+        assert out["placements_per_sec"] > 0, name
+        assert len(out["digests"]) == out["rounds_run"], name
+        assert len(out["delta_digests"]) == out["rounds_run"], name
+
+
+def test_scenario_sync_streaming_drain_equivalence(tmp_path):
+    """Synchronous and streaming drives of the same plan are
+    drain-equivalent: identical per-round placement digests AND
+    identical enacted delta streams — and a same-seed re-run is
+    bit-identical (seeded determinism through the whole stack)."""
+    for name in ("diurnal", "node_churn"):
+        plan = _plan(name)
+        sync = drive_scenario(plan, out_dir=str(tmp_path))
+        assert sync["ok"], (name, sync.get("failure"))
+        stream = drive_scenario(
+            plan, streaming=True, out_dir=str(tmp_path)
+        )
+        assert stream["ok"], (name, stream.get("failure"))
+        assert stream["mode"] == "streaming"
+        assert stream["digests"] == sync["digests"], name
+        assert stream["delta_digests"] == sync["delta_digests"], name
+        assert stream["scenario_digest"] == sync["scenario_digest"], name
+
+    rerun = drive_scenario(_plan("diurnal"), out_dir=str(tmp_path))
+    base = drive_scenario(_plan("diurnal"), out_dir=str(tmp_path))
+    assert rerun["digests"] == base["digests"]
+    assert rerun["delta_digests"] == base["delta_digests"]
+    assert rerun["scenario_digest"] == base["scenario_digest"]
+
+
+def test_scenario_robustness_score(tmp_path):
+    """Robustness under chaos-seeded cost perturbation: three perturbed
+    re-drives, every correctness gate still armed (a perturbed run that
+    diverges or recompiles zeroes the score), and the regression
+    quantiles fold into a (0, 1] score."""
+    out = score_scenario(
+        _plan("diurnal"), perturb_seeds=(1, 2, 3),
+    )
+    assert out["gates_ok"], out.get("failures")
+    assert out["perturb_seeds"] == [1, 2, 3]
+    assert len(out["objectives"]) == 3
+    assert len(out["regressions"]) == 3
+    assert 0.0 < out["robustness_score"] <= 1.0
+    assert out["regression_p50"] <= out["regression_p90"] <= (
+        out["regression_max"]
+    )
+    assert 0.0 <= out["placement_divergence"] <= 1.0
+
+
+def test_scenario_kill_and_redrive(tmp_path):
+    """Kill the Firmament stub mid-scenario: the crash-loop budget stops
+    the loop fatally, the flight recorder writes a scenario trace (full
+    materialized plan embedded), and the replay package re-drives it
+    offline to the identical failing round."""
+    kill_round = 3
+
+    def kill(r, ctx):
+        if r == kill_round:
+            ctx["server"].stop(grace=0.1)
+
+    out = drive_scenario(
+        _plan("diurnal"), out_dir=str(tmp_path), on_round=kill,
+    )
+    assert not out["ok"]
+    assert out["failure"]["kind"] == "fatal"
+    assert out["failing_round"] == kill_round
+
+    trace = load_flight(out["trace_path"])
+    assert len(trace.rounds) == kill_round
+    assert trace.failure["round"] == kill_round
+    assert trace.spec["kind"] == "scenario"
+    assert trace.spec["plan"]["name"] == "diurnal"
+
+    # replay/ lowers the embedded plan to trace events directly...
+    events = flight_trace_events(out["trace_path"])
+    report = ReplayDriver(events, precompile=False).run(max_rounds=2)
+    assert report.placed > 0
+
+    # ...and the re-drive lands on the identical failing round with
+    # byte-identical per-round placements.
+    redriven = redrive_flight(out["trace_path"])
+    assert redriven["reproduced"], redriven.get("digest_mismatches")
+    assert redriven["rounds_run"] == kill_round

@@ -207,9 +207,10 @@ def test_solve_tier_sharded_telemetry_ride_through():
     assert "poseidon_round_shard_devices 8" in text
     assert "poseidon_round_shard_imbalance 1.25" in text
 
-    # The soak's byte-identity gate accepts the tier (its sub-reports
-    # are the same to_dict wire format).
-    assert "sharded" in soak._KNOWN_TIERS
+    # The shared drive harness (soak + scenario) accepts the tier, and
+    # the soak's sub-reports are the same to_dict wire format.
+    from poseidon_tpu.chaos.harness import KNOWN_TIERS
+    assert "sharded" in KNOWN_TIERS
     assert soak._metrics_dict(m)["solve_tier"] == "sharded"
 
 

@@ -308,6 +308,50 @@ def compare(
         })
     elif isinstance(base_tp, (int, float)) or isinstance(cur_tp, (int, float)):
         skipped.append("throughput.placements_per_sec")
+    # Scenario rung series: per-scenario throughput and robustness are
+    # INVERTED like the throughput series (falling below the band is
+    # the regression); admission staleness gates in the normal
+    # direction with the timing floor (it is a latency).  One-side-only
+    # scenarios are skipped rows, so a fresh artifact diffs cleanly
+    # against baselines predating the rung.
+    base_sc = (baseline.get("scenario") or {}).get("scenarios") or {}
+    cur_sc = (current.get("scenario") or {}).get("scenarios") or {}
+    for sc in sorted(set(base_sc) ^ set(cur_sc)):
+        skipped.append(f"scenario.{sc}")
+    for sc in sorted(set(base_sc) & set(cur_sc)):
+        b_e, c_e = base_sc[sc], cur_sc[sc]
+        for key, inverted, floor in (
+            ("placements_per_sec", True, 0.0),
+            ("robustness_score", True, 0.0),
+            ("admission_staleness_p50_s", False, abs_floor_s),
+        ):
+            b, c = b_e.get(key), c_e.get(key)
+            name = f"scenario.{sc}.{key}"
+            if not (isinstance(b, (int, float))
+                    and isinstance(c, (int, float))):
+                if isinstance(b, (int, float)) or isinstance(
+                        c, (int, float)):
+                    skipped.append(name)
+                continue
+            ratio = (c / b) if b > 0 else float("inf")
+            verdict = "ok"
+            if inverted:
+                if c < b * (1.0 - tolerance):
+                    verdict = "regression"
+                    regressions.append(name)
+                elif c > b * (1.0 + tolerance):
+                    verdict = "improved"
+            else:
+                if c > b * (1.0 + tolerance) and (c - b) > floor:
+                    verdict = "regression"
+                    regressions.append(name)
+                elif c < b * (1.0 - tolerance) and (b - c) > floor:
+                    verdict = "improved"
+            rows.append({
+                "name": name, "baseline_s": float(b),
+                "current_s": float(c),
+                "ratio": round(ratio, 3), "verdict": verdict,
+            })
     return {
         "comparable": True, "reason": None, "rows": rows,
         "skipped": sorted(skipped), "regressions": regressions,
